@@ -1,0 +1,29 @@
+"""coreth_tpu — a TPU-native EVM chain execution framework.
+
+A ground-up rebuild of the capabilities of coreth (Avalanche's C-Chain VM,
+reference mounted at /root/reference) designed TPU-first: the host runtime
+(trie, state, EVM, consensus adapter, txpool, sync, RPC) is fresh Python/C++,
+and the state-commitment hot path — Keccak-256 over Merkle-Patricia-Trie node
+RLP — runs as batched JAX/Pallas kernels on TPU, sharded over a device mesh
+for multi-chip scale.
+
+Package map (mirrors SURVEY.md §2's component inventory):
+  ops/        keccak kernels (reference, XLA, Pallas) + RLP
+  native/     C++ host-side crypto (ctypes)
+  trie/       Merkle-Patricia-Trie, StackTrie, proofs, trie database
+  state/      journaled StateDB, snapshots, pruner
+  evm/        EVM interpreter, precompiles (incl. tpu_keccak)
+  core/       types, blockchain, processor, txpool, rawdb
+  consensus/  dummy engine + dynamic fees
+  miner/      block assembly
+  params/     chain config + fork schedule
+  parallel/   device-mesh sharding of hash batches
+  sync/       state sync (handlers/client/segments)
+  peer/       app-level network abstraction
+  vm/         snowman ChainVM adapter, atomic txs
+  rpc/        JSON-RPC server + eth/debug APIs
+  crypto/     secp256k1, signatures
+  ethdb/      KV backends
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,99 @@
+"""Native (C++) host-runtime components, loaded over ctypes.
+
+The reference's only native-adjacent pieces are its crypto deps (SURVEY.md
+§2.6). Here the native layer is the fast host-side keccak used below the TPU
+batch threshold and as the CPU baseline for benchmarks. Compiled lazily with
+g++ on first import; falls back to None (callers then use the pure-Python
+reference) when no toolchain is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "keccak.cpp")
+_LIB = os.path.join(_DIR, "libkeccak.so")
+
+_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC",
+        "-o", _LIB, _SRC, "-lpthread",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def load():
+    """Return the ctypes lib, building it if needed, or None on failure."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            if not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.keccak256.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+        ]
+        lib.keccak256_batch.argtypes = [
+            ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
+            ctypes.c_uint64,
+            ctypes.c_char_p,
+        ]
+        lib.keccak256_batch_mt.argtypes = lib.keccak256_batch.argtypes + [ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def keccak256(data: bytes) -> bytes:
+    lib = load()
+    if lib is None:
+        from ..ops.keccak_ref import keccak256 as ref
+        return ref(data)
+    out = ctypes.create_string_buffer(32)
+    lib.keccak256(data, len(data), out)
+    return out.raw
+
+
+def keccak256_batch(msgs, threads: int = 0) -> list:
+    """Hash a list of byte strings on the CPU; threads=0 means single-thread."""
+    n = len(msgs)
+    if n == 0:
+        return []
+    lib = load()
+    if lib is None:
+        from ..ops.keccak_ref import keccak256 as ref
+        return [ref(m) for m in msgs]
+    blob = b"".join(msgs)
+    offsets = np.zeros(n + 1, dtype=np.uint64)
+    np.cumsum(np.fromiter((len(m) for m in msgs), np.uint64, count=n), out=offsets[1:])
+    out = ctypes.create_string_buffer(32 * n)
+    if threads and threads > 1:
+        lib.keccak256_batch_mt(blob, offsets, n, out, threads)
+    else:
+        lib.keccak256_batch(blob, offsets, n, out)
+    raw = out.raw
+    return [raw[32 * i:32 * i + 32] for i in range(n)]
