@@ -1,0 +1,42 @@
+"""Merkle-Patricia-Trie package — the hot component (SURVEY.md §2.1).
+
+Rebuilds the capabilities of /root/reference/trie/: the MPT itself, the
+streaming StackTrie, secure (keccak-keyed) tries, proofs, iteration, and the
+pluggable hasher seam where the TPU keccak batch plugs in.
+"""
+
+from .encoding import (
+    compact_to_hex,
+    hex_to_compact,
+    hex_to_keybytes,
+    key_to_hex,
+    prefix_len,
+)
+from .hasher import BATCH_THRESHOLD, BatchedHasher, Hasher, new_hasher, node_to_bytes
+from .iterator import iterate_leaves, iterate_nodes
+from .node import (
+    EMPTY_ROOT,
+    FullNode,
+    HashNode,
+    MissingNodeError,
+    ShortNode,
+    ValueNode,
+    must_decode_node,
+)
+from .proof import prove, verify_proof
+from .secure import StateTrie
+from .stacktrie import StackTrie
+from .trie import NodeReader, Trie
+from .triedb import TrieDatabase
+from .trienode import MergedNodeSet, Node, NodeSet
+
+__all__ = [
+    "Trie", "StateTrie", "StackTrie", "NodeReader", "TrieDatabase",
+    "EMPTY_ROOT", "FullNode", "ShortNode", "HashNode", "ValueNode",
+    "MissingNodeError", "must_decode_node",
+    "Hasher", "BatchedHasher", "new_hasher", "node_to_bytes", "BATCH_THRESHOLD",
+    "NodeSet", "MergedNodeSet", "Node",
+    "prove", "verify_proof",
+    "iterate_leaves", "iterate_nodes",
+    "key_to_hex", "hex_to_compact", "compact_to_hex", "hex_to_keybytes", "prefix_len",
+]

@@ -1,0 +1,200 @@
+"""Trie node hashing — the north-star seam.
+
+The reference hashes nodes with a recursive CPU walk, fanning out 16
+goroutines per branch when >=100 nodes are unhashed (/root/reference/trie/
+hasher.go:57,124-139; trie/trie.go:618-619). Here the same factory seam
+exposes two backends:
+
+  Hasher         — recursive CPU hasher over the C++ keccak (the fallback
+                   for small dirty sets, where kernel-launch latency would
+                   dominate).
+  BatchedHasher  — level-synchronized data-parallel hashing: the dirty
+                   subtree is grouped by height, each level's node RLP is
+                   hashed as ONE batch on the TPU keccak kernel, and
+                   digests feed the next level's RLP. This is the TPU-native
+                   replacement for the goroutine fan-out.
+
+Both are bit-exact: node RLP < 32 bytes is embedded in the parent instead of
+hashed (trie/hasher.go:160-175 semantics), and the root is always hashed.
+
+new_hasher() picks a backend by dirty-node count, mirroring the reference's
+parallel threshold.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+from .. import rlp
+from ..native import keccak256 as _cpu_keccak
+from .encoding import hex_to_compact
+from .node import FullNode, HashNode, ShortNode, ValueNode
+
+# Below this many dirty nodes the CPU hasher wins (kernel launch + transfer
+# latency); mirrors the reference's >=100-unhashed parallel threshold.
+BATCH_THRESHOLD = 100
+
+
+def node_items(n, child_repr: Callable = None):
+    """Collapsed node -> python RLP structure (lists/bytes).
+
+    child_repr maps a child node to its reference representation; by default
+    children must already be HashNode/ValueNode/None/embedded Short/Full.
+    """
+    if isinstance(n, ShortNode):
+        return [hex_to_compact(n.key), _ref_item(n.val, child_repr)]
+    if isinstance(n, FullNode):
+        items = [_ref_item(c, child_repr) for c in n.children[:16]]
+        v = n.children[16]
+        items.append(bytes(v) if isinstance(v, ValueNode) else b"")
+        return items
+    raise TypeError(f"cannot encode {type(n)}")
+
+
+def _ref_item(child, child_repr):
+    if child is None:
+        return b""
+    if isinstance(child, (HashNode, ValueNode)):
+        return bytes(child)
+    if child_repr is not None:
+        rep = child_repr(child)
+        if rep is not None:
+            return rep
+    # embedded small node
+    return node_items(child, child_repr)
+
+
+def node_to_bytes(n) -> bytes:
+    return rlp.encode(node_items(n))
+
+
+class Hasher:
+    """Recursive CPU hasher: hash(n, force) -> (hashed_ref, n).
+
+    hashed_ref is a HashNode when the encoding is >=32 bytes (or force),
+    else the collapsed node itself for embedding in the parent. Hashes are
+    cached in node flags; clean nodes short-circuit.
+    """
+
+    def __init__(self, keccak: Callable[[bytes], bytes] = _cpu_keccak):
+        self._keccak = keccak
+
+    def hash(self, n, force: bool):
+        if isinstance(n, (ShortNode, FullNode)):
+            cached = n.flags.hash
+            if cached is not None:
+                return HashNode(cached), n
+            collapsed = self._collapse(n)
+            return self._store(collapsed, n, force), n
+        return n, n  # HashNode / ValueNode pass through
+
+    def _collapse(self, n):
+        if isinstance(n, ShortNode):
+            val = n.val
+            if isinstance(val, (ShortNode, FullNode)):
+                val, _ = self.hash(val, False)
+            return ShortNode(n.key, val)
+        children = [None] * 17
+        for i in range(16):
+            c = n.children[i]
+            if c is not None:
+                children[i], _ = self.hash(c, False) if isinstance(
+                    c, (ShortNode, FullNode)
+                ) else (c, c)
+        children[16] = n.children[16]
+        return FullNode(children)
+
+    def _store(self, collapsed, orig, force: bool):
+        enc = node_to_bytes(collapsed)
+        if len(enc) < 32 and not force:
+            return collapsed
+        h = HashNode(self._keccak(enc))
+        orig.flags.hash = bytes(h)
+        orig.flags.dirty = True
+        return h
+
+
+class BatchedHasher:
+    """Level-synchronized batched hasher for large dirty sets.
+
+    Walk once to group dirty nodes by height (leaves-first); per level,
+    build every node's RLP with children resolved to digests (or embedded
+    items), then hash the whole level in one device batch. The <32-byte
+    embed rule is resolved on host between levels, as SURVEY.md §7 "hard
+    part 1" requires.
+    """
+
+    def __init__(self, batch_keccak: Callable[[Sequence[bytes]], List[bytes]]):
+        self._batch = batch_keccak
+
+    def hash_root(self, root) -> HashNode:
+        if not isinstance(root, (ShortNode, FullNode)):
+            raise TypeError("batched hasher needs a Short/Full root")
+        levels = self._collect_levels(root)
+        reprs: dict = {}  # id(node) -> RLP item (bytes digest or embedded list)
+        encs: dict = {}
+        for depth, level in enumerate(levels):
+            pending_nodes = []
+            pending_rlp = []
+            for n in level:
+                items = node_items(n, child_repr=lambda c: self._child_repr(c, reprs))
+                enc = rlp.encode(items)
+                is_root = n is root
+                if len(enc) < 32 and not is_root:
+                    reprs[id(n)] = items  # embed in parent
+                else:
+                    pending_nodes.append(n)
+                    pending_rlp.append(enc)
+            if pending_rlp:
+                digests = self._batch(pending_rlp)
+                for n, d in zip(pending_nodes, digests):
+                    n.flags.hash = d
+                    reprs[id(n)] = d
+                    encs[id(n)] = True
+        return HashNode(root.flags.hash)
+
+    @staticmethod
+    def _child_repr(child, reprs):
+        if isinstance(child, (ShortNode, FullNode)):
+            if child.flags.hash is not None:
+                return child.flags.hash
+            rep = reprs.get(id(child))
+            if rep is None:
+                raise RuntimeError("child hashed out of order")
+            return rep if isinstance(rep, list) else rep
+        return None  # default handling (HashNode/ValueNode/None)
+
+    @staticmethod
+    def _collect_levels(root):
+        """Group dirty (unhashed) Short/Full nodes by height, leaves first."""
+        levels: List[list] = []
+
+        def visit(n) -> int:
+            # returns height of n within the dirty subtree; -1 for non-nodes
+            if not isinstance(n, (ShortNode, FullNode)) or n.flags.hash is not None:
+                return -1
+            h = -1
+            if isinstance(n, ShortNode):
+                h = max(h, visit(n.val))
+            else:
+                for c in n.children[:16]:
+                    h = max(h, visit(c))
+            h += 1
+            while len(levels) <= h:
+                levels.append([])
+            levels[h].append(n)
+            return h
+
+        visit(root)
+        return levels
+
+
+def new_hasher(dirty_estimate: int = 0, batch_keccak=None):
+    """Factory seam (trie/hasher.go:57 newHasher equivalent).
+
+    Returns a BatchedHasher when the dirty set is large and a device batch
+    fn is available, else the recursive CPU hasher.
+    """
+    if batch_keccak is not None and dirty_estimate >= BATCH_THRESHOLD:
+        return BatchedHasher(batch_keccak)
+    return Hasher()

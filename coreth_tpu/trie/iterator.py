@@ -1,0 +1,85 @@
+"""Trie iteration in key order (role of /root/reference/trie/iterator.go).
+
+`iterate_leaves` yields (key_bytes, value) pairs in ascending key order,
+resolving nodes lazily; `iterate_nodes` yields every resolved node with its
+path (used by sync handlers and dumps).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+from .encoding import hex_to_keybytes, key_to_hex
+from .node import FullNode, HashNode, ShortNode, ValueNode
+from .trie import Trie
+
+
+def _strip_term(hexkey: bytes) -> bytes:
+    return hexkey[:-1] if hexkey and hexkey[-1] == 16 else hexkey
+
+
+def iterate_leaves(
+    trie: Trie, start: Optional[bytes] = None
+) -> Iterator[Tuple[bytes, bytes]]:
+    """Yield (key_bytes, value) in key order, keys >= ``start``.
+
+    Hex paths compare lexicographically in the same order as keys, so a
+    subtree rooted at path P can be pruned iff P < start_hex[:len(P)]
+    (i.e. every key below it sorts before start).
+    """
+    start_hex = _strip_term(key_to_hex(start)) if start else b""
+
+    def before_start(path: bytes) -> bool:
+        return path < start_hex[: len(path)]
+
+    def walk(n, path: bytes):
+        if isinstance(n, HashNode):
+            n = trie._resolve(n, path)
+        if n is None:
+            return
+        if isinstance(n, ValueNode):
+            if path >= start_hex:
+                yield hex_to_keybytes(path), bytes(n)
+            return
+        if isinstance(n, ShortNode):
+            child_path = path + _strip_term(n.key)
+            if isinstance(n.val, ValueNode):
+                if child_path >= start_hex:
+                    yield hex_to_keybytes(child_path), bytes(n.val)
+            elif not before_start(child_path):
+                yield from walk(n.val, child_path)
+            return
+        if isinstance(n, FullNode):
+            if n.children[16] is not None and path >= start_hex:
+                yield hex_to_keybytes(path), bytes(n.children[16])
+            for i in range(16):
+                c = n.children[i]
+                if c is None:
+                    continue
+                child_path = path + bytes([i])
+                if not before_start(child_path):
+                    yield from walk(c, child_path)
+            return
+        raise TypeError(f"invalid node {type(n)}")
+
+    yield from walk(trie.root, b"")
+
+
+def iterate_nodes(trie: Trie) -> Iterator[Tuple[bytes, object]]:
+    """Yield (path, node) for every resolved node, preorder."""
+
+    def walk(n, path: bytes):
+        if isinstance(n, HashNode):
+            n = trie._resolve(n, path)
+        if n is None:
+            return
+        yield path, n
+        if isinstance(n, ShortNode):
+            if not isinstance(n.val, ValueNode):
+                yield from walk(n.val, path + n.key)
+        elif isinstance(n, FullNode):
+            for i in range(16):
+                if n.children[i] is not None:
+                    yield from walk(n.children[i], path + bytes([i]))
+
+    yield from walk(trie.root, b"")

@@ -1,0 +1,218 @@
+"""In-memory dirty-node forest with ref-counting GC.
+
+Semantics of /root/reference/trie/triedb/hashdb/database.go (dirties map,
+reference/dereference, Cap, Commit) plus the trie/database_wrap.go:82-277
+wrapper: Update merges a commit's NodeSets, UpdateAndReferenceRoot pins the
+accepted chain's roots, Cap flushes oldest-first when over the memory limit,
+Commit(root) persists a root's whole subtree to disk.
+
+Nodes are stored on disk keyed by their hash (legacy hashdb scheme the
+reference uses). The database also owns the device keccak-batch handle so
+every trie it opens hashes through the TPU seam.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from ..ethdb import KeyValueStore
+from .node import (
+    EMPTY_ROOT,
+    FullNode,
+    HashNode,
+    ShortNode,
+    ValueNode,
+    must_decode_node,
+)
+from .secure import StateTrie
+from .trie import Trie
+from .trienode import MergedNodeSet, NodeSet
+from .. import rlp
+
+
+class _CachedNode:
+    __slots__ = ("blob", "parents", "external")
+
+    def __init__(self, blob: bytes):
+        self.blob = blob
+        self.parents = 0
+        self.external = 0  # root pins from the chain (versiondb analog)
+
+
+def _child_hashes(blob: bytes):
+    """Walk a node blob for 32-byte child references (incl. embedded)."""
+    out = []
+
+    def walk(n):
+        if isinstance(n, HashNode):
+            out.append(bytes(n))
+        elif isinstance(n, ShortNode):
+            walk(n.val)
+        elif isinstance(n, FullNode):
+            for c in n.children[:16]:
+                if c is not None:
+                    walk(c)
+
+    walk(must_decode_node(None, blob))
+    return out
+
+
+class TrieDatabase:
+    def __init__(
+        self,
+        diskdb: KeyValueStore,
+        batch_keccak: Optional[Callable] = None,
+        dirty_limit_bytes: int = 512 * 1024 * 1024,
+    ):
+        self.diskdb = diskdb
+        self.batch_keccak = batch_keccak
+        self.dirty_limit = dirty_limit_bytes
+        self._dirties: Dict[bytes, _CachedNode] = {}  # insertion-ordered
+        self._dirty_size = 0
+        self._cleans: Dict[bytes, bytes] = {}  # simple clean cache
+        self._clean_limit = 64 * 1024 * 1024
+        self._clean_size = 0
+
+    # ----------------------------------------------------------- node reads
+
+    def node(self, path: bytes, node_hash: bytes) -> Optional[bytes]:
+        c = self._dirties.get(node_hash)
+        if c is not None:
+            return c.blob
+        blob = self._cleans.get(node_hash)
+        if blob is not None:
+            return blob
+        blob = self.diskdb.get(node_hash)
+        if blob is not None and self._clean_size < self._clean_limit:
+            self._cleans[node_hash] = blob
+            self._clean_size += len(blob)
+        return blob
+
+    def open_trie(self, root: bytes = EMPTY_ROOT) -> Trie:
+        return Trie(root, self, self.batch_keccak)
+
+    def open_state_trie(self, root: bytes = EMPTY_ROOT, **kw) -> StateTrie:
+        return StateTrie(root, self, self.batch_keccak, **kw)
+
+    # --------------------------------------------------------------- update
+
+    def _insert(self, node_hash: bytes, blob: bytes) -> None:
+        if node_hash in self._dirties:
+            return
+        entry = _CachedNode(blob)
+        self._dirties[node_hash] = entry
+        self._dirty_size += len(blob) + 32
+        for child in _child_hashes(blob):
+            c = self._dirties.get(child)
+            if c is not None:
+                c.parents += 1
+
+    def update(self, root: bytes, parent: bytes, nodes: MergedNodeSet) -> None:
+        """Merge one block's commit into the forest (database_wrap Update)."""
+        # insert storage tries first, then the account trie, so children
+        # exist when parent references are counted
+        account_set = nodes.sets.get(b"")
+        for owner, ns in nodes.sets.items():
+            if owner != b"":
+                self._insert_set(ns)
+        if account_set is not None:
+            self._insert_set(account_set)
+            # reference storage roots held by committed account leaves
+            for _parent_hash, account_blob in account_set.leaves:
+                try:
+                    fields = rlp.decode(account_blob)
+                    storage_root = fields[2] if isinstance(fields, list) and len(fields) >= 3 else None
+                except rlp.DecodeError:
+                    storage_root = None
+                if storage_root and storage_root != EMPTY_ROOT:
+                    c = self._dirties.get(bytes(storage_root))
+                    if c is not None:
+                        c.parents += 1
+
+    def _insert_set(self, ns: NodeSet) -> None:
+        # children-first: longer paths are deeper
+        for path in sorted(ns.nodes, key=len, reverse=True):
+            node = ns.nodes[path]
+            if not node.is_deleted:
+                self._insert(node.hash, node.blob)
+
+    def update_and_reference_root(self, root: bytes, parent: bytes, nodes: MergedNodeSet) -> None:
+        """Coreth's accepted-chain pinning (database_wrap.go:141)."""
+        self.update(root, parent, nodes)
+        self.reference(root)
+
+    # ----------------------------------------------------- refcounting / GC
+
+    def reference(self, root: bytes) -> None:
+        c = self._dirties.get(root)
+        if c is not None:
+            c.external += 1
+
+    def dereference(self, root: bytes) -> None:
+        """Drop an external pin; GC any now-unreachable subtree."""
+        c = self._dirties.get(root)
+        if c is None:
+            return
+        if c.external > 0:
+            c.external -= 1
+        self._maybe_gc(root)
+
+    def _maybe_gc(self, node_hash: bytes) -> None:
+        c = self._dirties.get(node_hash)
+        if c is None or c.parents > 0 or c.external > 0:
+            return
+        del self._dirties[node_hash]
+        self._dirty_size -= len(c.blob) + 32
+        for child in _child_hashes(c.blob):
+            cc = self._dirties.get(child)
+            if cc is not None and cc.parents > 0:
+                cc.parents -= 1
+                self._maybe_gc(child)
+
+    # ------------------------------------------------------- commit / flush
+
+    def commit(self, root: bytes) -> None:
+        """Persist root's subtree to disk, children first; drop from dirties."""
+        if root == EMPTY_ROOT or root not in self._dirties:
+            return
+        batch = self.diskdb.new_batch()
+        self._commit_walk(root, batch, set())
+        batch.write()
+
+    def _commit_walk(self, node_hash: bytes, batch, seen: set) -> None:
+        if node_hash in seen:
+            return
+        seen.add(node_hash)
+        c = self._dirties.get(node_hash)
+        if c is None:
+            return
+        for child in _child_hashes(c.blob):
+            self._commit_walk(child, batch, seen)
+        batch.put(node_hash, c.blob)
+        # committed nodes leave the dirty set (refs from remaining dirty
+        # parents no longer matter: reads fall through to disk)
+        del self._dirties[node_hash]
+        self._dirty_size -= len(c.blob) + 32
+        if self._clean_size < self._clean_limit:
+            self._cleans[node_hash] = c.blob
+            self._clean_size += len(c.blob)
+
+    def cap(self, limit_bytes: int) -> None:
+        """Flush oldest nodes to disk until memory usage <= limit."""
+        if self._dirty_size <= limit_bytes:
+            return
+        batch = self.diskdb.new_batch()
+        for node_hash in list(self._dirties):
+            if self._dirty_size <= limit_bytes:
+                break
+            c = self._dirties.pop(node_hash)
+            self._dirty_size -= len(c.blob) + 32
+            batch.put(node_hash, c.blob)
+        batch.write()
+
+    @property
+    def dirty_size(self) -> int:
+        return self._dirty_size
+
+    def __contains__(self, node_hash: bytes) -> bool:
+        return node_hash in self._dirties

@@ -1,0 +1,318 @@
+"""Trie parity suite — the analog of /root/reference/trie/trie_test.go.
+
+Known Ethereum root vectors, randomized op sequences vs a dict model
+(TestRandom analog), commit/reload roundtrips, StackTrie vs Trie root
+equivalence (TestCommitSequence analog), batched-hasher bit-exactness,
+proofs, and iteration order.
+"""
+
+import random
+
+import pytest
+
+from coreth_tpu import rlp
+from coreth_tpu.trie import (
+    EMPTY_ROOT,
+    BatchedHasher,
+    NodeReader,
+    StackTrie,
+    StateTrie,
+    Trie,
+    iterate_leaves,
+    prove,
+    verify_proof,
+)
+from coreth_tpu.native import keccak256, keccak256_batch
+
+
+def test_known_vectors():
+    t = Trie()
+    assert t.hash() == EMPTY_ROOT
+    for k, v in [(b"doe", b"reindeer"), (b"dog", b"puppy"), (b"dogglesworth", b"cat")]:
+        t.update(k, v)
+    assert t.hash().hex() == "8aad789dff2f538bca5d8ea56e8abe10f4c7ba3a5dea95fea4cd6e7c3a1168d3"
+
+    t = Trie()
+    t.update(b"A", b"aaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaa")
+    assert t.hash().hex() == "d23786fb4a010da3ce639d66d5e904a11dbc02746d1ce25029e53290cabf28ab"
+
+
+def test_empty_values_vector():
+    t = Trie()
+    ops = [
+        (b"do", b"verb"), (b"ether", b"wookiedoo"), (b"horse", b"stallion"),
+        (b"shaman", b"horse"), (b"doge", b"coin"), (b"ether", b""),
+        (b"dog", b"puppy"), (b"shaman", b""),
+    ]
+    for k, v in ops:
+        t.update(k, v)
+    assert t.hash().hex() == "5991bb8c6514148a29db676a14ac506cd2cd5775ace63c30a4fe457715e9ac84"
+
+
+def _random_ops(rng, n):
+    keys = [bytes([rng.randrange(256) for _ in range(rng.choice([1, 2, 4, 8, 32]))])
+            for _ in range(max(4, n // 4))]
+    ops = []
+    for _ in range(n):
+        k = rng.choice(keys)
+        if rng.random() < 0.3:
+            ops.append((k, b""))
+        else:
+            ops.append((k, bytes([rng.randrange(1, 256) for _ in range(rng.randrange(1, 80))])))
+    return ops
+
+
+def test_random_vs_model():
+    """TestRandom analog: trie ops mirror a dict; get/hash stay consistent."""
+    rng = random.Random(1234)
+    for trial in range(5):
+        t = Trie()
+        model = {}
+        for k, v in _random_ops(rng, 300):
+            t.update(k, v)
+            if v:
+                model[k] = v
+            else:
+                model.pop(k, None)
+        for k, v in model.items():
+            assert t.get(k) == v
+        # rebuild from scratch in a different order -> same root
+        t2 = Trie()
+        for k in sorted(model, reverse=True):
+            t2.update(k, model[k])
+        assert t.hash() == t2.hash()
+
+
+def test_commit_reload_roundtrip():
+    rng = random.Random(99)
+    store = {}
+    t = Trie(reader=NodeReader(store))
+    model = {}
+    for k, v in _random_ops(rng, 500):
+        t.update(k, v)
+        model[k] = v
+        if not v:
+            model.pop(k, None)
+    root, nodeset = t.commit()
+    assert nodeset is not None and len(nodeset) > 0
+    for node in nodeset.nodes.values():
+        assert keccak256(node.blob) == node.hash
+        store[node.hash] = node.blob
+    # reload from the store and check every key + incremental update
+    t2 = Trie(root, NodeReader(store))
+    for k, v in model.items():
+        assert t2.get(k) == v
+    t2.update(b"new-key", b"new-value")
+    t3 = Trie(root, NodeReader(store))
+    assert t3.get(b"new-key") is None
+    assert t2.get(b"new-key") == b"new-value"
+    # committing the incremental change and reloading again works
+    root2, ns2 = t2.commit()
+    for node in ns2.nodes.values():
+        store[node.hash] = node.blob
+    t4 = Trie(root2, NodeReader(store))
+    assert t4.get(b"new-key") == b"new-value"
+    for k, v in model.items():
+        assert t4.get(k) == v
+
+
+def test_committed_trie_rejects_writes():
+    t = Trie()
+    t.update(b"a", b"b")
+    t.commit()
+    with pytest.raises(RuntimeError):
+        t.update(b"c", b"d")
+
+
+def test_stacktrie_matches_trie():
+    """TestCommitSequence analog: StackTrie == Trie for sorted keys."""
+    rng = random.Random(7)
+    for n in (1, 2, 17, 100, 500):
+        items = {}
+        while len(items) < n:
+            items[bytes(rng.randrange(256) for _ in range(32))] = bytes(
+                rng.randrange(1, 256) for _ in range(rng.randrange(1, 60))
+            )
+        t = Trie()
+        st_nodes = {}
+        st = StackTrie(write_fn=lambda path, h, blob: st_nodes.__setitem__(h, blob))
+        for k in sorted(items):
+            t.update(k, items[k])
+            st.update(k, items[k])
+        assert st.hash() == t.hash(), f"n={n}"
+        # every written stacktrie node is a valid preimage
+        for h, blob in st_nodes.items():
+            assert keccak256(blob) == h
+
+
+def test_stacktrie_rejects_unsorted():
+    st = StackTrie()
+    st.update(b"b" * 32, b"1")
+    with pytest.raises(ValueError):
+        st.update(b"a" * 32, b"1")
+    with pytest.raises(ValueError):
+        st.update(b"b" * 32, b"2")
+
+
+def test_batched_hasher_bit_exact():
+    """CPU recursive hasher vs level-batched hasher: identical roots."""
+    rng = random.Random(5)
+    for n in (1, 5, 120, 400):
+        items = {}
+        while len(items) < n:
+            items[bytes(rng.randrange(256) for _ in range(rng.choice([3, 20, 32])))] = bytes(
+                rng.randrange(1, 256) for _ in range(rng.randrange(1, 80))
+            )
+        t_cpu = Trie()
+        t_dev = Trie(batch_keccak=lambda msgs: keccak256_batch(msgs))
+        t_dev.unhashed = 10**6  # force the batched path regardless of count
+        for k, v in items.items():
+            t_cpu.update(k, v)
+            t_dev.update(k, v)
+        t_dev.unhashed = 10**6
+        assert t_cpu.hash() == t_dev.hash(), f"n={n}"
+        # commit after batched hashing produces valid blobs
+        root, ns = t_dev.commit()
+        assert root == t_cpu.hash()
+        if ns:
+            for node in ns.nodes.values():
+                assert keccak256(node.blob) == node.hash
+
+
+def test_batched_hasher_jax_backend():
+    """Same check through the actual XLA keccak batch (CPU backend)."""
+    from coreth_tpu.ops.keccak_jax import keccak256_batch as jax_batch
+
+    rng = random.Random(6)
+    items = {bytes(rng.randrange(256) for _ in range(32)): b"v" * rng.randrange(1, 40)
+             for _ in range(150)}
+    t_cpu, t_dev = Trie(), Trie(batch_keccak=jax_batch)
+    for k, v in items.items():
+        t_cpu.update(k, v)
+        t_dev.update(k, v)
+    t_dev.unhashed = 10**6
+    assert t_cpu.hash() == t_dev.hash()
+
+
+def test_secure_trie():
+    st = StateTrie(record_preimages=True)
+    st.update(b"alpha", b"1")
+    st.update(b"beta", b"2")
+    assert st.get(b"alpha") == b"1"
+    assert st.get(b"missing") is None
+    hk = st.hash_key(b"alpha")
+    assert st.get_key(hk) == b"alpha"
+    # secure trie root differs from plain trie with same keys
+    t = Trie()
+    t.update(b"alpha", b"1")
+    t.update(b"beta", b"2")
+    assert st.hash() != t.hash()
+
+
+def test_proofs():
+    rng = random.Random(11)
+    items = {bytes(rng.randrange(256) for _ in range(8)): bytes(
+        rng.randrange(1, 256) for _ in range(rng.randrange(1, 50))) for _ in range(100)}
+    t = Trie()
+    for k, v in items.items():
+        t.update(k, v)
+    root = t.hash()
+    for k in list(items)[:20]:
+        proof_nodes = prove(t, k)
+        db = {keccak256(b): b for b in proof_nodes}
+        assert verify_proof(root, k, db) == items[k]
+    # absence proof
+    absent = b"\xff" * 8
+    assert absent not in items
+    db = {keccak256(b): b for b in prove(t, absent)}
+    assert verify_proof(root, absent, db) is None
+    # tampering detection
+    k = list(items)[0]
+    db = {keccak256(b): b for b in prove(t, k)}
+    bad = dict(db)
+    first = next(iter(bad))
+    bad[first] = bad[first][:-1] + bytes([bad[first][-1] ^ 1])
+    with pytest.raises(ValueError):
+        verify_proof(root, k, bad)
+
+
+def test_iterator_order_and_start():
+    rng = random.Random(13)
+    items = {bytes(rng.randrange(256) for _ in range(4)): b"v" for _ in range(200)}
+    t = Trie()
+    for k, v in items.items():
+        t.update(k, v)
+    got = [k for k, _ in iterate_leaves(t)]
+    assert got == sorted(items)
+    start = sorted(items)[57]
+    got2 = [k for k, _ in iterate_leaves(t, start=start)]
+    assert got2 == sorted(items)[57:]
+    # start between keys
+    import struct
+    mid = bytes(a for a in start[:-1]) + bytes([start[-1] + 1])
+    got3 = [k for k, _ in iterate_leaves(t, start=mid)]
+    assert got3 == [k for k in sorted(items) if k >= mid]
+
+
+def test_rlp_roundtrip():
+    cases = [b"", b"\x00", b"a", b"dog", b"x" * 55, b"y" * 56, b"z" * 1000,
+             [], [b"a"], [b"a", [b"b", []]], [b"x" * 100, [b"y" * 60]]]
+    for c in cases:
+        assert rlp.decode(rlp.encode(c)) == (c if not isinstance(c, list) else c)
+    assert rlp.encode(0) == b"\x80"
+    assert rlp.encode(15) == b"\x0f"
+    assert rlp.encode(1024) == b"\x82\x04\x00"
+    with pytest.raises(rlp.DecodeError):
+        rlp.decode(b"\x81\x01")  # non-canonical single byte
+    with pytest.raises(rlp.DecodeError):
+        rlp.decode(rlp.encode(b"abc") + b"\x00")  # trailing bytes
+
+
+def test_triedb_update_commit_reload():
+    from coreth_tpu.ethdb import MemoryDB
+    from coreth_tpu.trie.triedb import TrieDatabase
+    from coreth_tpu.trie import MergedNodeSet
+
+    disk = MemoryDB()
+    tdb = TrieDatabase(disk)
+    t = tdb.open_trie()
+    rng = random.Random(3)
+    model = {}
+    for _ in range(300):
+        k = bytes(rng.randrange(256) for _ in range(6))
+        v = bytes(rng.randrange(1, 256) for _ in range(rng.randrange(1, 60)))
+        t.update(k, v)
+        model[k] = v
+    root, ns = t.commit()
+    merged = MergedNodeSet()
+    merged.merge(ns)
+    tdb.update_and_reference_root(root, EMPTY_ROOT, merged)
+    # before disk commit: readable through the dirty forest
+    t2 = tdb.open_trie(root)
+    for k, v in list(model.items())[:50]:
+        assert t2.get(k) == v
+    assert len(disk) == 0
+    # commit to disk and read back with a fresh database
+    tdb.commit(root)
+    assert len(disk) > 0
+    tdb2 = TrieDatabase(disk)
+    t3 = tdb2.open_trie(root)
+    for k, v in model.items():
+        assert t3.get(k) == v
+
+
+def test_triedb_dereference_gc():
+    from coreth_tpu.ethdb import MemoryDB
+    from coreth_tpu.trie.triedb import TrieDatabase
+    from coreth_tpu.trie import MergedNodeSet
+
+    tdb = TrieDatabase(MemoryDB())
+    t = tdb.open_trie()
+    for i in range(100):
+        t.update(b"key-%03d" % i, b"val-%03d" % i)
+    root, ns = t.commit()
+    m = MergedNodeSet(); m.merge(ns)
+    tdb.update_and_reference_root(root, EMPTY_ROOT, m)
+    assert tdb.dirty_size > 0
+    tdb.dereference(root)
+    assert tdb.dirty_size == 0  # fully GC'd
