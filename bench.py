@@ -1,0 +1,116 @@
+"""Headline benchmark: trie-root commitment nodes/sec, TPU-batched vs CPU.
+
+Builds a random N-account state trie (the BASELINE.json config-#2 workload,
+scaled by CORETH_TPU_BENCH_LEAVES), then times root hashing of the full
+dirty set two ways:
+
+  cpu: the recursive host hasher over the C++ keccak — the reference's
+       trie/hasher.go path (its 16-goroutine fan-out maps to our
+       single-thread C++ walk; see BASELINE.md).
+  tpu: the level-synchronized BatchedHasher draining every level's node RLP
+       to the JAX keccak kernel on the default backend.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline is the TPU/CPU throughput ratio (>1 is a win). Roots are
+asserted bit-identical before any number is reported.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+
+
+def build_trie(n_leaves: int, seed: int = 1):
+    from coreth_tpu.trie.trie import Trie
+
+    rng = random.Random(seed)
+    t = Trie()
+    for _ in range(n_leaves):
+        key = rng.randbytes(32)
+        val = rng.randbytes(rng.randint(40, 90))  # account-RLP-sized payloads
+        t.update(key, val)
+    return t
+
+
+def count_dirty(root) -> int:
+    from coreth_tpu.trie.node import FullNode, ShortNode
+
+    n = 0
+    stack = [root]
+    while stack:
+        x = stack.pop()
+        if isinstance(x, ShortNode):
+            n += 1
+            stack.append(x.val)
+        elif isinstance(x, FullNode):
+            n += 1
+            stack.extend(c for c in x.children[:16] if c is not None)
+    return n
+
+
+def time_hash(trie, batch_fn, repeats: int):
+    """Best-of-N wall time hashing a fresh copy of the dirty trie."""
+    from coreth_tpu.trie.hasher import BatchedHasher, Hasher
+
+    best = float("inf")
+    root_hash = None
+    for _ in range(repeats):
+        t = trie.copy()
+        t0 = time.perf_counter()
+        if batch_fn is None:
+            h, _ = Hasher().hash(t.root, True)
+            rh = bytes(h)
+        else:
+            rh = bytes(BatchedHasher(batch_fn).hash_root(t.root))
+        best = min(best, time.perf_counter() - t0)
+        if root_hash is None:
+            root_hash = rh
+        assert rh == root_hash
+    return best, root_hash
+
+
+def main():
+    n_leaves = int(os.environ.get("CORETH_TPU_BENCH_LEAVES", "200000"))
+    repeats = int(os.environ.get("CORETH_TPU_BENCH_REPEATS", "3"))
+
+    from coreth_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+    from coreth_tpu.ops.keccak_jax import keccak256_batch
+
+    trie = build_trie(n_leaves)
+    nodes = count_dirty(trie.root)
+
+    # warm up the device path on the same workload so every batch-bucket
+    # shape is compiled (and disk-cached) before the clock starts
+    time_hash(trie, keccak256_batch, 1)
+
+    cpu_s, cpu_root = time_hash(trie, None, repeats)
+    tpu_s, tpu_root = time_hash(trie, keccak256_batch, repeats)
+    if cpu_root != tpu_root:
+        print(
+            json.dumps({"error": "root mismatch", "cpu": cpu_root.hex(), "tpu": tpu_root.hex()}),
+            file=sys.stderr,
+        )
+        sys.exit(1)
+
+    tpu_rate = nodes / tpu_s
+    cpu_rate = nodes / cpu_s
+    print(
+        json.dumps(
+            {
+                "metric": "trie_commit_nodes_per_sec",
+                "value": round(tpu_rate, 1),
+                "unit": "nodes/s",
+                "vs_baseline": round(tpu_rate / cpu_rate, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

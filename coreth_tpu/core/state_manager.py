@@ -1,0 +1,121 @@
+"""Trie commitment policy (semantics of /root/reference/core/state_manager.go).
+
+Two TrieWriter flavors drive the TrieDatabase from the chain:
+
+  NoPruningTrieWriter      — archival: commit every block's root to disk
+                             (state_manager.go:97-113).
+  CappedMemoryTrieWriter   — pruning: keep roots in the in-memory forest,
+                             commit to disk every COMMIT_INTERVAL accepted
+                             blocks, keep a TIP_BUFFER of dereferenceable
+                             roots, and optimistically flush within the last
+                             FLUSH_WINDOW blocks before a commit boundary
+                             (state_manager.go:43-58,126-186).
+"""
+
+from __future__ import annotations
+
+from ..trie.node import EMPTY_ROOT
+from ..trie.triedb import TrieDatabase
+
+COMMIT_INTERVAL = 4096
+TIP_BUFFER_SIZE = 32
+FLUSH_WINDOW = 768
+
+
+class TrieWriter:
+    def insert_trie(self, block) -> None:
+        raise NotImplementedError
+
+    def accept_trie(self, block) -> None:
+        raise NotImplementedError
+
+    def reject_trie(self, block) -> None:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        raise NotImplementedError
+
+
+class NoPruningTrieWriter(TrieWriter):
+    def __init__(self, triedb: TrieDatabase):
+        self.db = triedb
+
+    def insert_trie(self, block) -> None:
+        self.db.reference(block.root)
+
+    def accept_trie(self, block) -> None:
+        self.db.commit(block.root)
+
+    def reject_trie(self, block) -> None:
+        self.db.dereference(block.root)
+
+    def shutdown(self) -> None:
+        pass
+
+
+class CappedMemoryTrieWriter(TrieWriter):
+    def __init__(
+        self,
+        triedb: TrieDatabase,
+        commit_interval: int = COMMIT_INTERVAL,
+        memory_cap: int = 512 * 1024 * 1024,
+        image_cap: int = 4 * 1024 * 1024,
+    ):
+        self.db = triedb
+        self.commit_interval = commit_interval
+        self.memory_cap = memory_cap
+        self.image_cap = image_cap
+        self.tip_buffer = _BoundedBuffer(TIP_BUFFER_SIZE, self._dereference)
+        self._last_accepted_root = EMPTY_ROOT
+
+    def _dereference(self, root: bytes) -> None:
+        self.db.dereference(root)
+
+    def insert_trie(self, block) -> None:
+        self.db.reference(block.root)
+        if self.db.dirty_size + 0 > self.memory_cap:
+            self.db.cap(self.memory_cap - self.image_cap)
+
+    def accept_trie(self, block) -> None:
+        root = block.root
+        if root != EMPTY_ROOT:
+            self.tip_buffer.insert(root)
+        height = block.number
+        if self.commit_interval and height % self.commit_interval == 0:
+            self.db.commit(root)
+            self._last_accepted_root = root
+            return
+        # optimistic flush window: spread the big interval commit's IO over
+        # the preceding FLUSH_WINDOW blocks (state_manager.go:160-186)
+        distance = self.commit_interval - (height % self.commit_interval)
+        if distance <= FLUSH_WINDOW:
+            target = self.db.dirty_size * (FLUSH_WINDOW - distance) // FLUSH_WINDOW
+            if target < self.db.dirty_size:
+                self.db.cap(max(target, self.image_cap))
+
+    def reject_trie(self, block) -> None:
+        self.db.dereference(block.root)
+
+    def shutdown(self) -> None:
+        """Commit the last accepted root so restart can recover from <=
+        commit_interval blocks back (state_manager.go Shutdown)."""
+        last = self.tip_buffer.last()
+        if last is not None:
+            self.db.commit(last)
+
+
+class _BoundedBuffer:
+    """FIFO of size N; evicted items get the callback (state_manager.go:189+)."""
+
+    def __init__(self, size: int, on_evict):
+        self._size = size
+        self._on_evict = on_evict
+        self._items: list = []
+
+    def insert(self, item) -> None:
+        self._items.append(item)
+        if len(self._items) > self._size:
+            self._on_evict(self._items.pop(0))
+
+    def last(self):
+        return self._items[-1] if self._items else None

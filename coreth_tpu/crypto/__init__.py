@@ -1,0 +1,16 @@
+"""Cryptography: keccak256 (native C++ / TPU-batched) and secp256k1."""
+
+from ..native import keccak256, keccak256_batch
+from .secp256k1 import (
+    ecrecover,
+    priv_to_address,
+    pubkey,
+    pubkey_to_address,
+    recover_address,
+    sign,
+)
+
+__all__ = [
+    "ecrecover", "keccak256", "keccak256_batch", "priv_to_address",
+    "pubkey", "pubkey_to_address", "recover_address", "sign",
+]

@@ -1,0 +1,377 @@
+"""alt_bn128 (BN254) curve ops for precompiles 0x06-0x08.
+
+Pure-Python implementation of G1 add/scalar-mul and the optimal ate pairing
+check (role of the reference's precompiles via github.com/ethereum/go-ethereum
+/crypto/bn256). Field towers: Fp2 = Fp[u]/(u^2+1), Fp12 = Fp2[w]/(w^6 - (9+u)).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+P = 21888242871839275222246405745257275088696311157297823662689037894645226208583
+N = 21888242871839275222246405745257275088548364400416034343698204186575808495617
+
+# curve: y^2 = x^3 + 3;  twist: y^2 = x^3 + 3/(9+u)
+
+
+def _inv(a: int, m: int = P) -> int:
+    return pow(a, m - 2, m)
+
+
+# --- G1 -----------------------------------------------------------------
+
+G1Point = Optional[Tuple[int, int]]  # None = infinity
+
+
+def g1_is_on_curve(pt: G1Point) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    if x >= P or y >= P:
+        return False
+    return (y * y - x * x * x - 3) % P == 0
+
+
+def g1_add(a: G1Point, b: G1Point) -> G1Point:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    x1, y1 = a
+    x2, y2 = b
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None
+        lam = (3 * x1 * x1) * _inv(2 * y1) % P
+    else:
+        lam = (y2 - y1) * _inv(x2 - x1) % P
+    x3 = (lam * lam - x1 - x2) % P
+    y3 = (lam * (x1 - x3) - y1) % P
+    return (x3, y3)
+
+
+def g1_mul(a: G1Point, k: int) -> G1Point:
+    out: G1Point = None
+    add = a
+    while k:
+        if k & 1:
+            out = g1_add(out, add)
+        add = g1_add(add, add)
+        k >>= 1
+    return out
+
+
+# --- Fp2 / Fp6 / Fp12 towers -------------------------------------------
+# Fp2 elements are (a, b) = a + b*u.
+
+def f2_add(x, y):
+    return ((x[0] + y[0]) % P, (x[1] + y[1]) % P)
+
+
+def f2_sub(x, y):
+    return ((x[0] - y[0]) % P, (x[1] - y[1]) % P)
+
+
+def f2_mul(x, y):
+    a = (x[0] * y[0] - x[1] * y[1]) % P
+    b = (x[0] * y[1] + x[1] * y[0]) % P
+    return (a, b)
+
+
+def f2_muls(x, s: int):
+    return ((x[0] * s) % P, (x[1] * s) % P)
+
+
+def f2_inv(x):
+    d = _inv((x[0] * x[0] + x[1] * x[1]) % P)
+    return ((x[0] * d) % P, (-x[1] * d) % P)
+
+
+def f2_neg(x):
+    return ((-x[0]) % P, (-x[1]) % P)
+
+
+F2_ZERO = (0, 0)
+F2_ONE = (1, 0)
+XI = (9, 1)  # 9 + u, the sextic twist constant
+
+
+# Fp12 as pairs of Fp6; Fp6 as triples of Fp2 (c0 + c1*v + c2*v^2, v^3 = xi)
+
+def f6_add(x, y):
+    return tuple(f2_add(a, b) for a, b in zip(x, y))
+
+
+def f6_sub(x, y):
+    return tuple(f2_sub(a, b) for a, b in zip(x, y))
+
+
+def f6_mul(x, y):
+    a0, a1, a2 = x
+    b0, b1, b2 = y
+    t0 = f2_mul(a0, b0)
+    t1 = f2_mul(a1, b1)
+    t2 = f2_mul(a2, b2)
+    c0 = f2_add(t0, f2_mul(XI, f2_sub(f2_mul(f2_add(a1, a2), f2_add(b1, b2)), f2_add(t1, t2))))
+    c1 = f2_add(f2_sub(f2_mul(f2_add(a0, a1), f2_add(b0, b1)), f2_add(t0, t1)), f2_mul(XI, t2))
+    c2 = f2_add(f2_sub(f2_mul(f2_add(a0, a2), f2_add(b0, b2)), f2_add(t0, t2)), t1)
+    return (c0, c1, c2)
+
+
+def f6_neg(x):
+    return tuple(f2_neg(a) for a in x)
+
+
+def f6_mul_tau(x):  # multiply by v
+    return (f2_mul(XI, x[2]), x[0], x[1])
+
+
+def f6_inv(x):
+    a0, a1, a2 = x
+    t0 = f2_sub(f2_mul(a0, a0), f2_mul(XI, f2_mul(a1, a2)))
+    t1 = f2_sub(f2_mul(XI, f2_mul(a2, a2)), f2_mul(a0, a1))
+    t2 = f2_sub(f2_mul(a1, a1), f2_mul(a0, a2))
+    d = f2_add(
+        f2_mul(a0, t0),
+        f2_mul(XI, f2_add(f2_mul(a2, t1), f2_mul(a1, t2))),
+    )
+    di = f2_inv(d)
+    return (f2_mul(t0, di), f2_mul(t1, di), f2_mul(t2, di))
+
+
+F6_ZERO = (F2_ZERO, F2_ZERO, F2_ZERO)
+F6_ONE = (F2_ONE, F2_ZERO, F2_ZERO)
+
+
+def f12_mul(x, y):
+    a0, a1 = x
+    b0, b1 = y
+    t0 = f6_mul(a0, b0)
+    t1 = f6_mul(a1, b1)
+    c0 = f6_add(t0, f6_mul_tau(t1))
+    c1 = f6_sub(f6_mul(f6_add(a0, a1), f6_add(b0, b1)), f6_add(t0, t1))
+    return (c0, c1)
+
+
+def f12_square(x):
+    return f12_mul(x, x)
+
+
+def f12_inv(x):
+    a0, a1 = x
+    t = f6_inv(f6_sub(f6_mul(a0, a0), f6_mul_tau(f6_mul(a1, a1))))
+    return (f6_mul(a0, t), f6_neg(f6_mul(a1, t)))
+
+
+def f12_conj(x):
+    return (x[0], f6_neg(x[1]))
+
+
+def f12_pow(x, k: int):
+    out = F12_ONE
+    base = x
+    while k:
+        if k & 1:
+            out = f12_mul(out, base)
+        base = f12_square(base)
+        k >>= 1
+    return out
+
+
+F12_ONE = (F6_ONE, F6_ZERO)
+
+
+# Frobenius coefficients for Fp2: (a+bu)^p = a - bu
+def f2_conj(x):
+    return (x[0], (-x[1]) % P)
+
+
+# gamma constants: xi^((p-1)/6) powers
+_G_1 = [None] * 6
+_xi_p = pow(9 + 0, 1, P)  # placeholder; computed below properly
+
+
+def _f2_pow(x, k):
+    out = F2_ONE
+    b = x
+    while k:
+        if k & 1:
+            out = f2_mul(out, b)
+        b = f2_mul(b, b)
+        k >>= 1
+    return out
+
+
+_XI_P_16 = _f2_pow(XI, (P - 1) // 6)
+_GAMMA1 = [_f2_pow(_XI_P_16, i) for i in range(6)]
+_GAMMA2 = [f2_mul(g, f2_conj(g)) for g in _GAMMA1]
+_GAMMA3 = [f2_mul(g1, g2) for g1, g2 in zip(_GAMMA1, _GAMMA2)]
+
+
+def f12_frobenius(x):
+    (c00, c01, c02), (c10, c11, c12) = x
+    c00 = f2_conj(c00)
+    c01 = f2_mul(f2_conj(c01), _GAMMA1[2])
+    c02 = f2_mul(f2_conj(c02), _GAMMA1[4])
+    c10 = f2_mul(f2_conj(c10), _GAMMA1[1])
+    c11 = f2_mul(f2_conj(c11), _GAMMA1[3])
+    c12 = f2_mul(f2_conj(c12), _GAMMA1[5])
+    return ((c00, c01, c02), (c10, c11, c12))
+
+
+def f12_frobenius2(x):
+    (c00, c01, c02), (c10, c11, c12) = x
+    c01 = f2_mul(c01, _GAMMA2[2])
+    c02 = f2_mul(c02, _GAMMA2[4])
+    c10 = f2_mul(c10, _GAMMA2[1])
+    c11 = f2_mul(c11, _GAMMA2[3])
+    c12 = f2_mul(c12, _GAMMA2[5])
+    return ((c00, c01, c02), (c10, c11, c12))
+
+
+def f12_frobenius3(x):
+    (c00, c01, c02), (c10, c11, c12) = x
+    c00 = f2_conj(c00)
+    c01 = f2_mul(f2_conj(c01), _GAMMA3[2])
+    c02 = f2_mul(f2_conj(c02), _GAMMA3[4])
+    c10 = f2_mul(f2_conj(c10), _GAMMA3[1])
+    c11 = f2_mul(f2_conj(c11), _GAMMA3[3])
+    c12 = f2_mul(f2_conj(c12), _GAMMA3[5])
+    return ((c00, c01, c02), (c10, c11, c12))
+
+
+# --- G2 (points over Fp2, on the twist) --------------------------------
+
+G2Point = Optional[Tuple[Tuple[int, int], Tuple[int, int]]]
+
+_TWIST_B = f2_mul((3, 0), f2_inv(XI))
+
+
+def g2_is_on_curve(pt: G2Point) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    lhs = f2_mul(y, y)
+    rhs = f2_add(f2_mul(f2_mul(x, x), x), _TWIST_B)
+    return lhs == rhs
+
+
+def g2_add(a: G2Point, b: G2Point) -> G2Point:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    x1, y1 = a
+    x2, y2 = b
+    if x1 == x2:
+        if f2_add(y1, y2) == F2_ZERO:
+            return None
+        lam = f2_mul(f2_muls(f2_mul(x1, x1), 3), f2_inv(f2_muls(y1, 2)))
+    else:
+        lam = f2_mul(f2_sub(y2, y1), f2_inv(f2_sub(x2, x1)))
+    x3 = f2_sub(f2_sub(f2_mul(lam, lam), x1), x2)
+    y3 = f2_sub(f2_mul(lam, f2_sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def g2_mul(a: G2Point, k: int) -> G2Point:
+    out: G2Point = None
+    add = a
+    while k:
+        if k & 1:
+            out = g2_add(out, add)
+        add = g2_add(add, add)
+        k >>= 1
+    return out
+
+
+def g2_neg(a: G2Point) -> G2Point:
+    if a is None:
+        return None
+    return (a[0], f2_neg(a[1]))
+
+
+def g2_in_subgroup(pt: G2Point) -> bool:
+    return g2_mul(pt, N) is None
+
+
+# --- pairing (optimal ate via Miller loop) ------------------------------
+
+ATE_LOOP_COUNT = 29793968203157093288  # 6u+2 for BN254
+_LOG_ATE = [int(b) for b in bin(ATE_LOOP_COUNT)[2:]]
+
+
+def _line_eval(q1: Tuple, q2: Tuple, p: Tuple[int, int]):
+    """Evaluate the line through twist points q1,q2 at G1 point p, as Fp12.
+
+    Twist points are embedded: x in w^2 Fp2 coords, y in w^3 — we use the
+    standard D-type embedding where the line value lands in sparse Fp12.
+    """
+    x1, y1 = q1
+    x2, y2 = q2
+    px, py = p
+    if x1 != x2:
+        lam = f2_mul(f2_sub(y2, y1), f2_inv(f2_sub(x2, x1)))
+    elif y1 == y2:
+        lam = f2_mul(f2_muls(f2_mul(x1, x1), 3), f2_inv(f2_muls(y1, 2)))
+    else:
+        # vertical line: x - x1 evaluated at p, embedded in Fp12
+        c0 = (f2_muls(F2_ONE, px), F2_ZERO, F2_ZERO)
+        c0 = ((px % P, 0), f2_neg(x1), F2_ZERO)
+        return (c0, F6_ZERO)
+    # l = (y - y1) - lam*(x - x1) at p:
+    #   = py - y1 - lam*(px - x1)
+    # embedded: py*1 + (-lam)*px*w^... — use standard sparse coeffs:
+    # l(P) = py - lam*px*w + (lam*x1 - y1)*w^3  (D-twist embedding)
+    t = f2_sub(f2_mul(lam, x1), y1)
+    c0 = ((py % P, 0), F2_ZERO, F2_ZERO)
+    a0 = ((py % P, 0), t, F2_ZERO)
+    a1 = (f2_muls(lam, (-px) % P), F2_ZERO, F2_ZERO)
+    return (a0, a1)
+
+
+def miller_loop(q: G2Point, p: G1Point):
+    if q is None or p is None:
+        return F12_ONE
+    f = F12_ONE
+    t = q
+    for bit in _LOG_ATE[1:]:
+        f = f12_mul(f12_square(f), _line_eval(t, t, p))
+        t = g2_add(t, t)
+        if bit:
+            f = f12_mul(f, _line_eval(t, q, p))
+            t = g2_add(t, q)
+    # frobenius endomorphism steps (q1, -q2)
+    q1 = (
+        f2_mul(f2_conj(q[0]), _GAMMA1[2]),
+        f2_mul(f2_conj(q[1]), _GAMMA1[3]),
+    )
+    q2 = (
+        f2_mul(q[0], _GAMMA2[2]),
+        q[1],
+    )
+    f = f12_mul(f, _line_eval(t, q1, p))
+    t = g2_add(t, q1)
+    f = f12_mul(f, _line_eval(t, g2_neg(q2), p))
+    return f
+
+
+def final_exponentiation(f):
+    # easy part: f^((p^6-1)(p^2+1))
+    f1 = f12_conj(f)
+    f2 = f12_inv(f)
+    f = f12_mul(f1, f2)
+    f = f12_mul(f12_frobenius2(f), f)
+    # hard part: f^((p^4 - p^2 + 1)/n) — generic exponentiation (slow but
+    # correct; precompile gas prices this, and correctness beats speed here)
+    e = (P**4 - P**2 + 1) // N
+    return f12_pow(f, e)
+
+
+def pairing_check(pairs: List[Tuple[G1Point, G2Point]]) -> bool:
+    """True iff prod e(p_i, q_i) == 1."""
+    acc = F12_ONE
+    for p, q in pairs:
+        acc = f12_mul(acc, miller_loop(q, p))
+    return final_exponentiation(acc) == F12_ONE

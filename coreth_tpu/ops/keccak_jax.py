@@ -185,8 +185,17 @@ def digest_words_to_bytes(out: np.ndarray) -> list:
 
 
 def _pad_batch(words: np.ndarray, nblocks: np.ndarray, multiple: int = 128):
+    """Pad the batch dim to a power-of-two bucket (>= multiple).
+
+    Power-of-two buckets keep the set of compiled shapes logarithmic in the
+    batch size — a trie hash drains one differently-sized batch per level,
+    and each distinct shape costs a full XLA compile.
+    """
     b = words.shape[0]
-    pad = (-b) % multiple
+    target = multiple
+    while target < b:
+        target *= 2
+    pad = target - b
     if pad:
         words = np.concatenate(
             [words, np.zeros((pad,) + words.shape[1:], dtype=words.dtype)]

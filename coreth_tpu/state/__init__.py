@@ -1,0 +1,19 @@
+"""Mutable world state (role of /root/reference/core/state/)."""
+
+from .access_list import AccessList
+from .account import (
+    EMPTY_CODE_HASH,
+    Account,
+    normalize_coin_id,
+    normalize_state_key,
+)
+from .database import Database
+from .journal import Journal
+from .state_object import StateObject, ZERO32
+from .statedb import Log, StateDB
+
+__all__ = [
+    "AccessList", "Account", "Database", "EMPTY_CODE_HASH", "Journal",
+    "Log", "StateDB", "StateObject", "ZERO32",
+    "normalize_coin_id", "normalize_state_key",
+]

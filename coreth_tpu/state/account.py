@@ -1,0 +1,75 @@
+"""Account model (semantics of /root/reference/core/types/state_account.go).
+
+Coreth's StateAccount is geth's plus an IsMultiCoin flag (state_account.go:
+39-45): [nonce, balance, storage_root, code_hash, is_multi_coin], RLP in
+that order. Multicoin balances themselves live in the storage trie under
+bit-normalized keys (core/state/state_object.go:548-562).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .. import rlp
+from ..native import keccak256
+from ..trie.node import EMPTY_ROOT
+
+EMPTY_CODE_HASH = keccak256(b"")
+
+
+@dataclass
+class Account:
+    nonce: int = 0
+    balance: int = 0
+    root: bytes = EMPTY_ROOT
+    code_hash: bytes = EMPTY_CODE_HASH
+    is_multi_coin: bool = False
+
+    def encode(self) -> bytes:
+        return rlp.encode(
+            [
+                self.nonce,
+                self.balance,
+                self.root,
+                self.code_hash,
+                1 if self.is_multi_coin else 0,
+            ]
+        )
+
+    @classmethod
+    def decode(cls, blob: bytes) -> "Account":
+        items = rlp.decode(blob)
+        if not isinstance(items, list) or len(items) != 5:
+            raise rlp.DecodeError("bad account RLP")
+        return cls(
+            nonce=rlp.decode_uint(items[0]),
+            balance=rlp.decode_uint(items[1]),
+            root=items[2],
+            code_hash=items[3],
+            is_multi_coin=rlp.decode_uint(items[4]) != 0,
+        )
+
+    def copy(self) -> "Account":
+        return Account(
+            self.nonce, self.balance, self.root, self.code_hash, self.is_multi_coin
+        )
+
+    @property
+    def empty(self) -> bool:
+        """Reference Empty() (core/state/state_object.go:102)."""
+        return (
+            self.nonce == 0
+            and self.balance == 0
+            and self.code_hash == EMPTY_CODE_HASH
+            and not self.is_multi_coin
+        )
+
+
+def normalize_coin_id(coin_id: bytes) -> bytes:
+    """OR bit 0 of byte 0 (state_object.go:552): multicoin storage keys."""
+    return bytes([coin_id[0] | 0x01]) + coin_id[1:]
+
+
+def normalize_state_key(key: bytes) -> bytes:
+    """AND-out bit 0 of byte 0 (state_object.go:560): EVM storage keys."""
+    return bytes([key[0] & 0xFE]) + key[1:]

@@ -1,0 +1,46 @@
+"""State database wrapper (role of /root/reference/core/state/database.go).
+
+Opens account/storage tries against the TrieDatabase (which owns the TPU
+keccak-batch handle) and caches contract code read through rawdb.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..core import rawdb
+from ..trie.node import EMPTY_ROOT
+from ..trie.secure import StateTrie
+from ..trie.triedb import TrieDatabase
+
+CODE_CACHE_LIMIT = 64 * 1024 * 1024
+CODE_SIZE_CACHE = 100_000
+
+
+class Database:
+    def __init__(self, triedb: TrieDatabase):
+        self.triedb = triedb
+        self.diskdb = triedb.diskdb
+        self._code_cache: Dict[bytes, bytes] = {}
+        self._code_cache_size = 0
+
+    def open_trie(self, root: bytes = EMPTY_ROOT) -> StateTrie:
+        return self.triedb.open_state_trie(root)
+
+    def open_storage_trie(self, addr_hash: bytes, root: bytes) -> StateTrie:
+        # hashdb scheme: storage tries resolve by node hash, same namespace
+        return self.triedb.open_state_trie(root)
+
+    def contract_code(self, addr_hash: bytes, code_hash: bytes) -> Optional[bytes]:
+        code = self._code_cache.get(code_hash)
+        if code is not None:
+            return code
+        code = rawdb.read_code(self.diskdb, code_hash)
+        if code is not None and self._code_cache_size < CODE_CACHE_LIMIT:
+            self._code_cache[code_hash] = code
+            self._code_cache_size += len(code)
+        return code
+
+    def contract_code_size(self, addr_hash: bytes, code_hash: bytes) -> int:
+        code = self.contract_code(addr_hash, code_hash)
+        return len(code) if code else 0

@@ -1,0 +1,520 @@
+"""Journaled mutable world state (semantics of /root/reference/core/state/statedb.go).
+
+Execution mutates StateObjects through a journal (snapshot/revert); at tx end
+Finalise moves dirty state to pending; IntermediateRoot flushes pending
+storage into tries and returns the (TPU-batch-hashed) root; Commit persists
+everything into the TrieDatabase as NodeSets (statedb.go:903-1160 ordering).
+
+The flat-snapshot fast path is pluggable: StateDB reads through `snaps` when
+provided (core/state/snapshot analog, Phase 4).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import rlp
+from ..native import keccak256
+from ..core import rawdb
+from ..trie.node import EMPTY_ROOT
+from ..trie.trienode import MergedNodeSet
+from .access_list import AccessList
+from .account import Account, EMPTY_CODE_HASH, normalize_state_key
+from .database import Database
+from .journal import Journal
+from .state_object import ZERO32, StateObject
+
+RIPEMD_ADDR = (b"\x00" * 19) + b"\x03"  # the infamous touched-ripemd account
+
+
+class Log:
+    __slots__ = (
+        "address", "topics", "data", "block_number", "tx_hash", "tx_index",
+        "block_hash", "index",
+    )
+
+    def __init__(self, address: bytes, topics: List[bytes], data: bytes):
+        self.address = address
+        self.topics = topics
+        self.data = data
+        self.block_number = 0
+        self.tx_hash = b"\x00" * 32
+        self.tx_index = 0
+        self.block_hash = b"\x00" * 32
+        self.index = 0
+
+
+class StateDB:
+    def __init__(self, root: bytes, db: Database, snaps=None):
+        self.db = db
+        self.original_root = root
+        self.trie = db.open_trie(root)
+        self.journal = Journal()
+
+        self._objects: Dict[bytes, StateObject] = {}
+        self._objects_pending: Set[bytes] = set()
+        self._objects_dirty: Set[bytes] = set()
+
+        self.refund = 0
+        self.this_tx_hash = b"\x00" * 32
+        self.tx_index = 0
+        self.logs: Dict[bytes, List[Log]] = {}
+        self.log_size = 0
+        self.preimages: Dict[bytes, bytes] = {}
+
+        self.access_list = AccessList()
+        self.transient: Dict[Tuple[bytes, bytes], bytes] = {}
+
+        # flat snapshot tree (Phase 4); when set, reads go through it first
+        self.snaps = snaps
+        self.snap = snaps.snapshot(root) if snaps is not None else None
+        self._snap_destructs: Set[bytes] = set()
+        self._snap_accounts: Dict[bytes, bytes] = {}
+        self._snap_storage: Dict[bytes, Dict[bytes, bytes]] = {}
+
+    # ------------------------------------------------------------ object mgmt
+
+    def _get_state_object(self, addr: bytes) -> Optional[StateObject]:
+        obj = self._get_deleted_state_object(addr)
+        if obj is not None and obj.deleted:
+            return None
+        return obj
+
+    def _get_deleted_state_object(self, addr: bytes) -> Optional[StateObject]:
+        """Like _get_state_object but returns deleted-marked objects too
+        (getDeletedStateObject, statedb.go) — needed so recreate-after-
+        suicide journals a reset, not a create."""
+        obj = self._objects.get(addr)
+        if obj is not None:
+            return obj
+        return self._load_state_object(addr)
+
+    def _load_state_object(self, addr: bytes) -> Optional[StateObject]:
+        acct = None
+        addr_hash = keccak256(addr)
+        if self.snap is not None:
+            slim = self.snap.account(addr_hash)
+            if slim is not None:
+                if len(slim) == 0:
+                    return None
+                acct = _slim_to_account(slim)
+        if acct is None:
+            blob = self.trie.get(addr)
+            if not blob:
+                return None
+            acct = Account.decode(blob)
+        obj = StateObject(self, addr, acct)
+        self._objects[addr] = obj
+        return obj
+
+    def _get_or_new(self, addr: bytes) -> StateObject:
+        obj = self._get_state_object(addr)
+        if obj is None:
+            obj, _ = self._create_object(addr)
+        return obj
+
+    def _create_object(self, addr: bytes):
+        prev = self._get_deleted_state_object(addr)
+        obj = StateObject(self, addr, None)
+        if prev is None:
+            self.journal.append(_revert_create(addr), addr)
+        else:
+            self.journal.append(_revert_reset(addr, prev), addr)
+        self._objects[addr] = obj
+        return obj, prev
+
+    def create_account(self, addr: bytes) -> None:
+        """EIP-684/CREATE semantics: new object, balance carried over."""
+        new, prev = self._create_object(addr)
+        if prev is not None:
+            new.set_balance(prev.data.balance)
+
+    def exist(self, addr: bytes) -> bool:
+        return self._get_state_object(addr) is not None
+
+    def empty(self, addr: bytes) -> bool:
+        obj = self._get_state_object(addr)
+        return obj is None or obj.empty
+
+    # ---------------------------------------------------------------- reads
+
+    def get_balance(self, addr: bytes) -> int:
+        obj = self._get_state_object(addr)
+        return obj.data.balance if obj else 0
+
+    def get_balance_multicoin(self, addr: bytes, coin_id: bytes) -> int:
+        obj = self._get_state_object(addr)
+        return obj.balance_multicoin(coin_id) if obj else 0
+
+    def get_nonce(self, addr: bytes) -> int:
+        obj = self._get_state_object(addr)
+        return obj.data.nonce if obj else 0
+
+    def get_code(self, addr: bytes) -> bytes:
+        obj = self._get_state_object(addr)
+        return obj.get_code() if obj else b""
+
+    def get_code_size(self, addr: bytes) -> int:
+        return len(self.get_code(addr))
+
+    def get_code_hash(self, addr: bytes) -> bytes:
+        obj = self._get_state_object(addr)
+        return obj.data.code_hash if obj else b"\x00" * 32
+
+    def get_state(self, addr: bytes, key: bytes) -> bytes:
+        obj = self._get_state_object(addr)
+        if obj is None:
+            return ZERO32
+        return obj.get_state(normalize_state_key(key))
+
+    def get_committed_state(self, addr: bytes, key: bytes) -> bytes:
+        obj = self._get_state_object(addr)
+        if obj is None:
+            return ZERO32
+        return obj.get_committed_state(normalize_state_key(key))
+
+    def has_suicided(self, addr: bytes) -> bool:
+        obj = self._get_state_object(addr)
+        return obj.suicided if obj else False
+
+    # --------------------------------------------------------------- writes
+
+    def add_balance(self, addr: bytes, amount: int) -> None:
+        self._get_or_new(addr).add_balance(amount)
+
+    def sub_balance(self, addr: bytes, amount: int) -> None:
+        self._get_or_new(addr).sub_balance(amount)
+
+    def set_balance(self, addr: bytes, amount: int) -> None:
+        self._get_or_new(addr).set_balance(amount)
+
+    def add_balance_multicoin(self, addr: bytes, coin_id: bytes, amount: int) -> None:
+        self._get_or_new(addr).add_balance_multicoin(coin_id, amount)
+
+    def sub_balance_multicoin(self, addr: bytes, coin_id: bytes, amount: int) -> None:
+        self._get_or_new(addr).sub_balance_multicoin(coin_id, amount)
+
+    def set_nonce(self, addr: bytes, nonce: int) -> None:
+        self._get_or_new(addr).set_nonce(nonce)
+
+    def set_code(self, addr: bytes, code: bytes) -> None:
+        self._get_or_new(addr).set_code(keccak256(code), code)
+
+    def set_state(self, addr: bytes, key: bytes, value: bytes) -> None:
+        self._get_or_new(addr).set_state(normalize_state_key(key), value)
+
+    def suicide(self, addr: bytes) -> bool:
+        obj = self._get_state_object(addr)
+        if obj is None:
+            return False
+        self.journal.append(
+            _revert_suicide(addr, obj.suicided, obj.data.balance), addr
+        )
+        obj.mark_suicided()
+        obj.data.balance = 0
+        return True
+
+    # ---------------------------------------------------- transient (1153)
+
+    def get_transient_state(self, addr: bytes, key: bytes) -> bytes:
+        return self.transient.get((addr, key), ZERO32)
+
+    def set_transient_state(self, addr: bytes, key: bytes, value: bytes) -> None:
+        prev = self.get_transient_state(addr, key)
+        if prev == value:
+            return
+        self.journal.append(_revert_transient(addr, key, prev))
+        self.transient[(addr, key)] = value
+
+    # -------------------------------------------------------------- refunds
+
+    def add_refund(self, gas: int) -> None:
+        prev = self.refund
+        self.journal.append(_revert_refund(prev))
+        self.refund += gas
+
+    def sub_refund(self, gas: int) -> None:
+        prev = self.refund
+        if gas > self.refund:
+            raise ValueError(f"refund counter below zero ({self.refund} < {gas})")
+        self.journal.append(_revert_refund(prev))
+        self.refund -= gas
+
+    # ----------------------------------------------------------------- logs
+
+    def add_log(self, log: Log) -> None:
+        self.journal.append(_revert_log(self.this_tx_hash))
+        log.tx_hash = self.this_tx_hash
+        log.tx_index = self.tx_index
+        log.index = self.log_size
+        self.logs.setdefault(self.this_tx_hash, []).append(log)
+        self.log_size += 1
+
+    def get_logs(self, tx_hash: bytes, block_number: int, block_hash: bytes):
+        logs = self.logs.get(tx_hash, [])
+        for l in logs:
+            l.block_number = block_number
+            l.block_hash = block_hash
+        return logs
+
+    def add_preimage(self, hash_: bytes, preimage: bytes) -> None:
+        if hash_ not in self.preimages:
+            self.journal.append(_revert_preimage(hash_))
+            self.preimages[hash_] = preimage
+
+    # ------------------------------------------------------ tx context setup
+
+    def set_tx_context(self, tx_hash: bytes, tx_index: int) -> None:
+        self.this_tx_hash = tx_hash
+        self.tx_index = tx_index
+
+    def prepare(self, rules, sender, coinbase, dst, precompiles, tx_access_list):
+        """EIP-2929/2930/3651 warm-up (statedb.go Prepare)."""
+        if getattr(rules, "is_berlin", True):
+            self.access_list = AccessList()
+            self.access_list.add_address(sender)
+            if dst is not None:
+                self.access_list.add_address(dst)
+            for addr in precompiles:
+                self.access_list.add_address(addr)
+            if tx_access_list:
+                for addr, keys in tx_access_list:
+                    self.access_list.add_address(addr)
+                    for k in keys:
+                        self.access_list.add_slot(addr, k)
+            if getattr(rules, "is_shanghai", False) or getattr(rules, "is_d_upgrade", False):
+                self.access_list.add_address(coinbase)
+        self.transient = {}
+
+    def address_in_access_list(self, addr: bytes) -> bool:
+        return self.access_list.contains_address(addr)
+
+    def slot_in_access_list(self, addr: bytes, slot: bytes):
+        return self.access_list.contains(addr, slot)
+
+    def add_address_to_access_list(self, addr: bytes) -> None:
+        if self.access_list.add_address(addr):
+            self.journal.append(_revert_access_address(addr))
+
+    def add_slot_to_access_list(self, addr: bytes, slot: bytes) -> None:
+        addr_added, slot_added = self.access_list.add_slot(addr, slot)
+        if addr_added:
+            self.journal.append(_revert_access_address(addr))
+        if slot_added:
+            self.journal.append(_revert_access_slot(addr, slot))
+
+    # ----------------------------------------------------- snapshot machinery
+
+    def snapshot(self) -> int:
+        return self.journal.length()
+
+    def revert_to_snapshot(self, snap_id: int) -> None:
+        self.journal.revert(self, snap_id)
+
+    def snapshot_storage(self, addr_hash: bytes, key: bytes) -> Optional[bytes]:
+        """Flat-snapshot storage read hook used by StateObject."""
+        if self.snap is None:
+            return None
+        raw = self.snap.storage(addr_hash, keccak256(key))
+        if raw is None:
+            return None
+        if len(raw) == 0:
+            return ZERO32
+        return rlp.decode(raw).rjust(32, b"\x00")
+
+    # --------------------------------------------------- finalise/root/commit
+
+    def finalise(self, delete_empty: bool) -> None:
+        """Tx-end pass (statedb.go:903): fold journal dirties into pending."""
+        for addr in list(self.journal.dirties):
+            obj = self._objects.get(addr)
+            if obj is None:
+                continue
+            if obj.suicided or (delete_empty and obj.empty):
+                obj.deleted = True
+                self._snap_destructs.add(obj.addr_hash)
+                self._snap_accounts.pop(obj.addr_hash, None)
+                self._snap_storage.pop(obj.addr_hash, None)
+            else:
+                obj.finalise()
+            self._objects_pending.add(addr)
+            self._objects_dirty.add(addr)
+        self.journal = Journal()
+        self.refund = 0
+
+    def intermediate_root(self, delete_empty: bool) -> bytes:
+        """Hash the state trie after flushing pending (statedb.go:952).
+
+        Storage-root updates and account-trie writes happen here; the hash
+        itself drains through the TPU batch seam when the dirty set is big.
+        """
+        self.finalise(delete_empty)
+        for addr in sorted(self._objects_pending):
+            obj = self._objects[addr]
+            if obj.deleted:
+                self.trie.delete(addr)
+            else:
+                obj.update_root()
+                self.trie.update(addr, obj.data.encode())
+                if self.snap is not None:
+                    self._snap_accounts[obj.addr_hash] = _account_to_slim(obj.data)
+        self._objects_pending = set()
+        return self.trie.hash()
+
+    def commit(self, delete_empty: bool = False) -> bytes:
+        """Commit to the TrieDatabase (statedb.go:1040-1160).
+
+        Order: storage tries → code → account trie → TrieDB.Update.
+        Returns the new state root.
+        """
+        self.intermediate_root(delete_empty)
+        merged = MergedNodeSet()
+        for addr in sorted(self._objects_dirty):
+            obj = self._objects[addr]
+            if obj.deleted:
+                continue
+            if obj.dirty_code:
+                rawdb.write_code(self.db.diskdb, obj.data.code_hash, obj.code)
+                obj.dirty_code = False
+            nodeset = obj.commit_trie()
+            if nodeset is not None:
+                nodeset.owner = obj.addr_hash
+                merged.merge(nodeset)
+            if self.snap is not None and obj.snap_flush:
+                stor = self._snap_storage.setdefault(obj.addr_hash, {})
+                for k, v in obj.snap_flush.items():
+                    hk = keccak256(k)
+                    stor[hk] = rlp.encode(v.lstrip(b"\x00")) if v != ZERO32 else b""
+            obj.snap_flush = {}
+        root, acct_set = self.trie.commit(collect_leaf=True)
+        merged.merge(acct_set)
+        self._objects_dirty = set()
+        if root != self.original_root and merged.sets:
+            self.db.triedb.update(root, self.original_root, merged)
+        if self.snaps is not None and self.snap is not None:
+            if root != self.original_root:
+                self.snaps.update(
+                    root,
+                    self.original_root,
+                    self._snap_destructs,
+                    self._snap_accounts,
+                    self._snap_storage,
+                )
+            self._snap_destructs, self._snap_accounts, self._snap_storage = (
+                set(), {}, {},
+            )
+        return root
+
+    def copy(self) -> "StateDB":
+        s = StateDB.__new__(StateDB)
+        s.db = self.db
+        s.original_root = self.original_root
+        s.trie = self.trie.copy()
+        s.journal = Journal()
+        s._objects = {a: o.copy(s) for a, o in self._objects.items()}
+        # fold in-flight journal dirties into the copy's pending/dirty sets:
+        # the copy has an empty journal, so without this a mid-tx copy would
+        # lose the current tx's mutations at root computation (geth Copy)
+        s._objects_pending = set(self._objects_pending) | set(self.journal.dirties)
+        s._objects_dirty = set(self._objects_dirty) | set(self.journal.dirties)
+        s.refund = self.refund
+        s.this_tx_hash = self.this_tx_hash
+        s.tx_index = self.tx_index
+        s.logs = {h: list(ls) for h, ls in self.logs.items()}
+        s.log_size = self.log_size
+        s.preimages = dict(self.preimages)
+        s.access_list = self.access_list.copy()
+        s.transient = dict(self.transient)
+        s.snaps = self.snaps
+        s.snap = self.snap
+        s._snap_destructs = set(self._snap_destructs)
+        s._snap_accounts = dict(self._snap_accounts)
+        s._snap_storage = {k: dict(v) for k, v in self._snap_storage.items()}
+        return s
+
+
+# --- slim snapshot account codec (core/state/snapshot/account.go) ----------
+
+def _account_to_slim(acct: Account) -> bytes:
+    root = b"" if acct.root == EMPTY_ROOT else acct.root
+    code = b"" if acct.code_hash == EMPTY_CODE_HASH else acct.code_hash
+    return rlp.encode(
+        [acct.nonce, acct.balance, root, code, 1 if acct.is_multi_coin else 0]
+    )
+
+
+def _slim_to_account(blob: bytes) -> Account:
+    items = rlp.decode(blob)
+    root = items[2] if items[2] else EMPTY_ROOT
+    code = items[3] if items[3] else EMPTY_CODE_HASH
+    return Account(
+        nonce=rlp.decode_uint(items[0]),
+        balance=rlp.decode_uint(items[1]),
+        root=root,
+        code_hash=code,
+        is_multi_coin=rlp.decode_uint(items[4]) != 0,
+    )
+
+
+# --- journal closures for StateDB-level state -------------------------------
+
+def _revert_create(addr):
+    def rev(db):
+        db._objects.pop(addr, None)
+    return rev
+
+
+def _revert_reset(addr, prev):
+    def rev(db):
+        db._objects[addr] = prev
+    return rev
+
+
+def _revert_suicide(addr, prev_suicided, prev_balance):
+    def rev(db):
+        obj = db._objects.get(addr)
+        if obj is not None:
+            obj.suicided = prev_suicided
+            obj.data.balance = prev_balance
+    return rev
+
+
+def _revert_transient(addr, key, prev):
+    def rev(db):
+        db.transient[(addr, key)] = prev
+    return rev
+
+
+def _revert_refund(prev):
+    def rev(db):
+        db.refund = prev
+    return rev
+
+
+def _revert_log(tx_hash):
+    def rev(db):
+        logs = db.logs.get(tx_hash)
+        if logs:
+            logs.pop()
+            if not logs:
+                del db.logs[tx_hash]
+        db.log_size -= 1
+    return rev
+
+
+def _revert_preimage(hash_):
+    def rev(db):
+        db.preimages.pop(hash_, None)
+    return rev
+
+
+def _revert_access_address(addr):
+    def rev(db):
+        db.access_list.delete_address(addr)
+    return rev
+
+
+def _revert_access_slot(addr, slot):
+    def rev(db):
+        db.access_list.delete_slot(addr, slot)
+    return rev

@@ -1,0 +1,31 @@
+"""Shared helpers (role of /root/reference/utils/)."""
+
+from __future__ import annotations
+
+import os
+
+_cache_enabled = False
+
+
+def enable_compilation_cache(path: str | None = None) -> None:
+    """Persist XLA compilations across processes.
+
+    The keccak kernel compiles one program per (batch-bucket, block-bucket)
+    shape; with the disk cache a fresh process (bench run, node restart)
+    reuses them instead of paying the multi-second compile per shape again.
+    """
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    import jax
+
+    cache_dir = path or os.environ.get(
+        "CORETH_TPU_JAX_CACHE", os.path.expanduser("~/.cache/coreth_tpu_xla")
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass  # older jax without the knobs: cache is an optimization only
+    _cache_enabled = True

@@ -1,0 +1,237 @@
+"""State layer tests (model: /root/reference/core/state/statedb_test.go)."""
+
+import random
+
+import pytest
+
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.native import keccak256
+from coreth_tpu.state import Account, Database, StateDB, ZERO32
+from coreth_tpu.trie.node import EMPTY_ROOT
+from coreth_tpu.trie.triedb import TrieDatabase
+
+
+def new_state(batch_keccak=None):
+    triedb = TrieDatabase(MemoryDB(), batch_keccak=batch_keccak)
+    return StateDB(EMPTY_ROOT, Database(triedb))
+
+
+def addr(i: int) -> bytes:
+    return i.to_bytes(20, "big")
+
+
+def h32(i: int) -> bytes:
+    return i.to_bytes(32, "big")
+
+
+def test_balance_nonce_code_roundtrip():
+    s = new_state()
+    a = addr(1)
+    s.add_balance(a, 1000)
+    s.set_nonce(a, 7)
+    s.set_code(a, b"\x60\x00")
+    assert s.get_balance(a) == 1000
+    assert s.get_nonce(a) == 7
+    assert s.get_code(a) == b"\x60\x00"
+    assert s.get_code_hash(a) == keccak256(b"\x60\x00")
+
+    root = s.commit()
+    # reopen from the committed root
+    s2 = StateDB(root, s.db)
+    assert s2.get_balance(a) == 1000
+    assert s2.get_nonce(a) == 7
+    assert s2.get_code(a) == b"\x60\x00"
+
+
+def test_storage_roundtrip_and_normalization():
+    s = new_state()
+    a = addr(2)
+    s.set_state(a, h32(1), h32(42))
+    assert s.get_state(a, h32(1)) == h32(42)
+    # key normalization clears bit 0 of byte 0: 0x01... reads as 0x00...
+    k_odd = bytes([0x01]) + b"\x00" * 31
+    k_even = bytes([0x00]) + b"\x00" * 31
+    s.set_state(a, k_odd, h32(5))
+    assert s.get_state(a, k_even) == h32(5)
+
+    root = s.commit()
+    s2 = StateDB(root, s.db)
+    assert s2.get_state(a, h32(1)) == h32(42)
+    assert s2.get_state(a, k_even) == h32(5)
+    assert s2.get_state(a, h32(99)) == ZERO32
+
+
+def test_snapshot_revert():
+    s = new_state()
+    a = addr(3)
+    s.add_balance(a, 100)
+    snap = s.snapshot()
+    s.add_balance(a, 50)
+    s.set_state(a, h32(1), h32(9))
+    s.set_nonce(a, 3)
+    assert s.get_balance(a) == 150
+    s.revert_to_snapshot(snap)
+    assert s.get_balance(a) == 100
+    assert s.get_state(a, h32(1)) == ZERO32
+    assert s.get_nonce(a) == 0
+
+
+def test_revert_create_object():
+    s = new_state()
+    a = addr(4)
+    snap = s.snapshot()
+    s.add_balance(a, 1)
+    assert s.exist(a)
+    s.revert_to_snapshot(snap)
+    assert not s.exist(a)
+
+
+def test_multicoin():
+    s = new_state()
+    a = addr(5)
+    coin = h32(0xC0)
+    s.add_balance(a, 10)  # so the account isn't empty
+    s.add_balance_multicoin(a, coin, 77)
+    assert s.get_balance_multicoin(a, coin) == 77
+    s.sub_balance_multicoin(a, coin, 7)
+    assert s.get_balance_multicoin(a, coin) == 70
+    # coin balances must not collide with normalized state keys
+    assert s.get_state(a, coin) == ZERO32
+
+    root = s.commit()
+    s2 = StateDB(root, s.db)
+    assert s2.get_balance_multicoin(a, coin) == 70
+    # is_multi_coin survives the round trip
+    blob = s2.trie.get(a)
+    assert Account.decode(blob).is_multi_coin
+
+
+def test_suicide_and_empty_deletion():
+    s = new_state()
+    a = addr(6)
+    s.add_balance(a, 5)
+    s.commit()
+    assert s.suicide(a)
+    assert s.get_balance(a) == 0
+    s.finalise(True)
+    assert not s.exist(a)
+
+
+def test_refund_and_logs():
+    from coreth_tpu.state import Log
+
+    s = new_state()
+    s.set_tx_context(h32(0xAA), 0)
+    s.add_refund(100)
+    snap = s.snapshot()
+    s.add_refund(50)
+    s.add_log(Log(addr(1), [h32(1)], b"data"))
+    assert s.refund == 150
+    s.revert_to_snapshot(snap)
+    assert s.refund == 100
+    assert s.get_logs(h32(0xAA), 1, h32(0xBB)) == []
+
+
+def test_access_list_journal():
+    s = new_state()
+    a, slot = addr(7), h32(1)
+    snap = s.snapshot()
+    s.add_address_to_access_list(a)
+    s.add_slot_to_access_list(a, slot)
+    assert s.address_in_access_list(a)
+    assert s.slot_in_access_list(a, slot) == (True, True)
+    s.revert_to_snapshot(snap)
+    assert not s.address_in_access_list(a)
+
+
+def test_transient_storage():
+    s = new_state()
+    a, k = addr(8), h32(1)
+    snap = s.snapshot()
+    s.set_transient_state(a, k, h32(9))
+    assert s.get_transient_state(a, k) == h32(9)
+    s.revert_to_snapshot(snap)
+    assert s.get_transient_state(a, k) == ZERO32
+
+
+def test_intermediate_root_matches_commit_root():
+    s = new_state()
+    rng = random.Random(0)
+    for i in range(50):
+        a = addr(i + 100)
+        s.add_balance(a, rng.randint(1, 10**18))
+        s.set_nonce(a, rng.randint(0, 100))
+        for j in range(rng.randint(0, 4)):
+            s.set_state(a, h32(j), h32(rng.randint(1, 2**200)))
+    ir = s.intermediate_root(True)
+    root = s.commit()
+    assert ir == root
+
+
+def test_cpu_tpu_root_parity():
+    """Same mutations, CPU recursive hasher vs TPU-batched hasher: same root."""
+    from coreth_tpu.ops.keccak_jax import keccak256_batch
+
+    def build(batch):
+        s = new_state(batch)
+        rng = random.Random(42)
+        for i in range(300):  # above BATCH_THRESHOLD so the device path runs
+            a = rng.randbytes(20)
+            s.add_balance(a, rng.randint(1, 10**18))
+            s.set_nonce(a, rng.randint(0, 1000))
+            if i % 5 == 0:
+                for j in range(3):
+                    s.set_state(a, h32(j), h32(rng.randint(1, 2**255)))
+        return s.commit()
+
+    assert build(None) == build(keccak256_batch)
+
+
+def test_recreate_after_suicide_revert():
+    """Regression: create-after-suicide must journal a reset (deleted objects
+    included in the lookup), so a revert restores the deleted marker."""
+    db = Database(TrieDatabase(MemoryDB()))
+    s = StateDB(EMPTY_ROOT, db)
+    a = addr(60)
+    s.add_balance(a, 100)
+    root = s.commit()
+    s = StateDB(root, db)
+    s.suicide(a)
+    s.finalise(True)
+    snap = s.snapshot()
+    s.create_account(a)
+    s.revert_to_snapshot(snap)
+    assert s.intermediate_root(True) == EMPTY_ROOT
+
+
+def test_copy_mid_transaction_keeps_journal_dirties():
+    """Regression: a copy taken mid-tx (empty journal) must still fold the
+    journal-dirtied objects into its pending/dirty sets."""
+    s = new_state()
+    a = addr(61)
+    s.add_balance(a, 100)
+    c = s.copy()
+    assert c.intermediate_root(True) == s.intermediate_root(True)
+    assert c.intermediate_root(True) != EMPTY_ROOT
+
+
+def test_unprotected_legacy_tx_sender():
+    from coreth_tpu.core.types import Transaction, Signer
+    from coreth_tpu.crypto import priv_to_address
+
+    priv = bytes([0x46]) * 32
+    tx = Transaction(nonce=0, gas_price=1, gas=21000, to=addr(1), value=5)
+    Signer(0).sign(tx, priv)
+    assert tx.v in (27, 28)
+    # a chain-id signer must still recover unprotected txs (homestead hash)
+    assert Signer(1).sender(tx) == priv_to_address(priv)
+
+
+def test_copy_isolated():
+    s = new_state()
+    a = addr(9)
+    s.add_balance(a, 10)
+    c = s.copy()
+    c.add_balance(a, 5)
+    assert s.get_balance(a) == 10
+    assert c.get_balance(a) == 15
