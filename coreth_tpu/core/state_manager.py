@@ -65,6 +65,13 @@ class CappedMemoryTrieWriter(TrieWriter):
         self.commit_interval = commit_interval
         self.memory_cap = memory_cap
         self.image_cap = image_cap
+        # targetCommitSize / flushStepSize (state_manager.go:79-84): the
+        # window walks targetMemory down stepwise so the boundary commit
+        # only has ~target_commit_size left to write
+        self.target_commit_size = 20 * 1024 * 1024
+        self.flush_step_size = max(
+            (memory_cap - self.target_commit_size) // FLUSH_WINDOW, 1
+        )
         self.tip_buffer = _BoundedBuffer(TIP_BUFFER_SIZE, self._dereference)
         self._last_accepted_root = EMPTY_ROOT
 
@@ -86,12 +93,16 @@ class CappedMemoryTrieWriter(TrieWriter):
             self._last_accepted_root = root
             return
         # optimistic flush window: spread the big interval commit's IO over
-        # the preceding FLUSH_WINDOW blocks (state_manager.go:160-186)
+        # the preceding FLUSH_WINDOW blocks — targetMemory decreases
+        # stepwise toward target_commit_size at the boundary
+        # (state_manager.go:160-186)
         distance = self.commit_interval - (height % self.commit_interval)
-        if distance <= FLUSH_WINDOW:
-            target = self.db.dirty_size * (FLUSH_WINDOW - distance) // FLUSH_WINDOW
-            if target < self.db.dirty_size:
-                self.db.cap(max(target, self.image_cap))
+        if distance > FLUSH_WINDOW:
+            return
+        target_memory = self.target_commit_size + self.flush_step_size * distance
+        if self.db.dirty_size <= target_memory:
+            return
+        self.db.cap(max(target_memory - self.image_cap, 0))
 
     def reject_trie(self, block) -> None:
         self.db.dereference(block.root)

@@ -535,7 +535,10 @@ class Block:
         return self._hash
 
     def encode(self) -> bytes:
-        ext = [] if self.ext_data is None else self.ext_data
+        # ExtData is `*[]byte rlp:"nil"` in the reference (block.go:177):
+        # nil encodes as the empty RLP string 0x80, so None and b"" are
+        # indistinguishable on the wire and decode back to None
+        ext = b"" if self.ext_data is None else self.ext_data
         return rlp.encode(
             [
                 self.header.rlp_items(),

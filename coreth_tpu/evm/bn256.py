@@ -187,11 +187,6 @@ def f2_conj(x):
     return (x[0], (-x[1]) % P)
 
 
-# gamma constants: xi^((p-1)/6) powers
-_G_1 = [None] * 6
-_xi_p = pow(9 + 0, 1, P)  # placeholder; computed below properly
-
-
 def _f2_pow(x, k):
     out = F2_ONE
     b = x
@@ -297,76 +292,136 @@ def g2_in_subgroup(pt: G2Point) -> bool:
 
 
 # --- pairing (optimal ate via Miller loop) ------------------------------
+#
+# Implemented in the "embed everything in Fp12" style (the approach py_ecc
+# proved out for bn128): G2 points are mapped through the D-twist
+# ψ(x',y') = (x'·w², y'·w³) into E(Fp12), G1 points are lifted as Fp12
+# scalars, and the Miller loop uses the generic affine line function over
+# Fp12. Slower than a sparse-multiplication implementation, but the
+# precompile gas schedule prices pairings at 34k gas/point — correctness
+# dominates here.
 
 ATE_LOOP_COUNT = 29793968203157093288  # 6u+2 for BN254
 _LOG_ATE = [int(b) for b in bin(ATE_LOOP_COUNT)[2:]]
 
 
-def _line_eval(q1: Tuple, q2: Tuple, p: Tuple[int, int]):
-    """Evaluate the line through twist points q1,q2 at G1 point p, as Fp12.
+def f12_add(x, y):
+    return (f6_add(x[0], y[0]), f6_add(x[1], y[1]))
 
-    Twist points are embedded: x in w^2 Fp2 coords, y in w^3 — we use the
-    standard D-type embedding where the line value lands in sparse Fp12.
-    """
-    x1, y1 = q1
-    x2, y2 = q2
-    px, py = p
+
+def f12_sub(x, y):
+    return (f6_sub(x[0], y[0]), f6_sub(x[1], y[1]))
+
+
+def f12_neg(x):
+    return (f6_neg(x[0]), f6_neg(x[1]))
+
+
+def _f12_scalar(a: int):
+    """Lift a base-field element into Fp12."""
+    return (((a % P, 0), F2_ZERO, F2_ZERO), F6_ZERO)
+
+
+F12_ZERO = (F6_ZERO, F6_ZERO)
+
+
+def _twist(q: G2Point):
+    """ψ: E'(Fp2) → E(Fp12): (x', y') ↦ (x'·w², y'·w³). With the tower
+    Fp12 = Fp6[w], Fp6 = Fp2[v], v = w², this is x' into the v-slot of c0
+    and y' into the v-slot of c1."""
+    if q is None:
+        return None
+    x, y = q
+    return (
+        ((F2_ZERO, x, F2_ZERO), F6_ZERO),
+        (F6_ZERO, (F2_ZERO, y, F2_ZERO)),
+    )
+
+
+def _embed_g1(p: G1Point):
+    if p is None:
+        return None
+    return (_f12_scalar(p[0]), _f12_scalar(p[1]))
+
+
+def _ec12_double(pt):
+    x, y = pt
+    if y == F12_ZERO:
+        return None
+    three_x2 = f12_mul(_f12_scalar(3), f12_square(x))
+    m = f12_mul(three_x2, f12_inv(f12_add(y, y)))
+    x3 = f12_sub(f12_square(m), f12_add(x, x))
+    y3 = f12_sub(f12_mul(m, f12_sub(x, x3)), y)
+    return (x3, y3)
+
+
+def _ec12_add(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    x1, y1 = a
+    x2, y2 = b
+    if x1 == x2:
+        if y1 == y2:
+            return _ec12_double(a)
+        return None
+    m = f12_mul(f12_sub(y2, y1), f12_inv(f12_sub(x2, x1)))
+    x3 = f12_sub(f12_sub(f12_square(m), x1), x2)
+    y3 = f12_sub(f12_mul(m, f12_sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def _linefunc(p1, p2, t):
+    """Value of the line through p1,p2 (or the tangent at p1) at point t;
+    all points in E(Fp12) affine coordinates."""
+    x1, y1 = p1
+    x2, y2 = p2
+    xt, yt = t
     if x1 != x2:
-        lam = f2_mul(f2_sub(y2, y1), f2_inv(f2_sub(x2, x1)))
+        m = f12_mul(f12_sub(y2, y1), f12_inv(f12_sub(x2, x1)))
     elif y1 == y2:
-        lam = f2_mul(f2_muls(f2_mul(x1, x1), 3), f2_inv(f2_muls(y1, 2)))
+        three_x2 = f12_mul(_f12_scalar(3), f12_square(x1))
+        m = f12_mul(three_x2, f12_inv(f12_add(y1, y1)))
     else:
-        # vertical line: x - x1 evaluated at p, embedded in Fp12
-        c0 = (f2_muls(F2_ONE, px), F2_ZERO, F2_ZERO)
-        c0 = ((px % P, 0), f2_neg(x1), F2_ZERO)
-        return (c0, F6_ZERO)
-    # l = (y - y1) - lam*(x - x1) at p:
-    #   = py - y1 - lam*(px - x1)
-    # embedded: py*1 + (-lam)*px*w^... — use standard sparse coeffs:
-    # l(P) = py - lam*px*w + (lam*x1 - y1)*w^3  (D-twist embedding)
-    t = f2_sub(f2_mul(lam, x1), y1)
-    c0 = ((py % P, 0), F2_ZERO, F2_ZERO)
-    a0 = ((py % P, 0), t, F2_ZERO)
-    a1 = (f2_muls(lam, (-px) % P), F2_ZERO, F2_ZERO)
-    return (a0, a1)
+        return f12_sub(xt, x1)
+    return f12_sub(f12_mul(m, f12_sub(xt, x1)), f12_sub(yt, y1))
 
 
 def miller_loop(q: G2Point, p: G1Point):
     if q is None or p is None:
         return F12_ONE
+    tq = _twist(q)
+    tp = _embed_g1(p)
     f = F12_ONE
-    t = q
+    r = tq
     for bit in _LOG_ATE[1:]:
-        f = f12_mul(f12_square(f), _line_eval(t, t, p))
-        t = g2_add(t, t)
+        f = f12_mul(f12_square(f), _linefunc(r, r, tp))
+        r = _ec12_double(r)
         if bit:
-            f = f12_mul(f, _line_eval(t, q, p))
-            t = g2_add(t, q)
-    # frobenius endomorphism steps (q1, -q2)
-    q1 = (
-        f2_mul(f2_conj(q[0]), _GAMMA1[2]),
-        f2_mul(f2_conj(q[1]), _GAMMA1[3]),
-    )
-    q2 = (
-        f2_mul(q[0], _GAMMA2[2]),
-        q[1],
-    )
-    f = f12_mul(f, _line_eval(t, q1, p))
-    t = g2_add(t, q1)
-    f = f12_mul(f, _line_eval(t, g2_neg(q2), p))
+            f = f12_mul(f, _linefunc(r, tq, tp))
+            r = _ec12_add(r, tq)
+    # optimal-ate tail: Frobenius-twisted additions Q1, -Q2
+    q1 = (f12_frobenius(tq[0]), f12_frobenius(tq[1]))
+    nq2 = (f12_frobenius(q1[0]), f12_neg(f12_frobenius(q1[1])))
+    f = f12_mul(f, _linefunc(r, q1, tp))
+    r = _ec12_add(r, q1)
+    f = f12_mul(f, _linefunc(r, nq2, tp))
     return f
 
 
 def final_exponentiation(f):
     # easy part: f^((p^6-1)(p^2+1))
-    f1 = f12_conj(f)
-    f2 = f12_inv(f)
-    f = f12_mul(f1, f2)
+    f = f12_mul(f12_conj(f), f12_inv(f))
     f = f12_mul(f12_frobenius2(f), f)
     # hard part: f^((p^4 - p^2 + 1)/n) — generic exponentiation (slow but
-    # correct; precompile gas prices this, and correctness beats speed here)
+    # correct; the precompile gas schedule prices this in)
     e = (P**4 - P**2 + 1) // N
     return f12_pow(f, e)
+
+
+def pairing(q: G2Point, p: G1Point):
+    return final_exponentiation(miller_loop(q, p))
 
 
 def pairing_check(pairs: List[Tuple[G1Point, G2Point]]) -> bool:
@@ -375,3 +430,60 @@ def pairing_check(pairs: List[Tuple[G1Point, G2Point]]) -> bool:
     for p, q in pairs:
         acc = f12_mul(acc, miller_loop(q, p))
     return final_exponentiation(acc) == F12_ONE
+
+
+# --- EVM wire format (EIP-196/197 encodings used by precompiles 6-8) ----
+
+
+class PointNotOnCurve(Exception):
+    pass
+
+
+def g1_unmarshal(data: bytes) -> G1Point:
+    """64-byte big-endian (x || y); (0,0) is infinity."""
+    if len(data) != 64:
+        raise PointNotOnCurve("bad G1 length")
+    x = int.from_bytes(data[:32], "big")
+    y = int.from_bytes(data[32:], "big")
+    if x >= P or y >= P:
+        raise PointNotOnCurve("coordinate >= field modulus")
+    if x == 0 and y == 0:
+        return None
+    pt = (x, y)
+    if not g1_is_on_curve(pt):
+        raise PointNotOnCurve("not on curve")
+    return pt
+
+
+def g1_marshal(pt: G1Point) -> bytes:
+    if pt is None:
+        return b"\x00" * 64
+    return pt[0].to_bytes(32, "big") + pt[1].to_bytes(32, "big")
+
+
+def g2_unmarshal(data: bytes) -> G2Point:
+    """128-byte (x_imag || x_real || y_imag || y_real) per EIP-197; all-zero
+    is infinity. Subgroup membership is checked (gnark/bn256 does too)."""
+    if len(data) != 128:
+        raise PointNotOnCurve("bad G2 length")
+    xi = int.from_bytes(data[0:32], "big")
+    xr = int.from_bytes(data[32:64], "big")
+    yi = int.from_bytes(data[64:96], "big")
+    yr = int.from_bytes(data[96:128], "big")
+    if xi >= P or xr >= P or yi >= P or yr >= P:
+        raise PointNotOnCurve("coordinate >= field modulus")
+    if xi == 0 and xr == 0 and yi == 0 and yr == 0:
+        return None
+    pt = ((xr, xi), (yr, yi))
+    if not g2_is_on_curve(pt):
+        raise PointNotOnCurve("not on twist")
+    if not g2_in_subgroup(pt):
+        raise PointNotOnCurve("not in r-torsion subgroup")
+    return pt
+
+
+def g2_marshal_eip197(pt: G2Point) -> bytes:
+    if pt is None:
+        return b"\x00" * 128
+    (xr, xi), (yr, yi) = pt
+    return b"".join(v.to_bytes(32, "big") for v in (xi, xr, yi, yr))

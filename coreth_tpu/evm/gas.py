@@ -62,6 +62,9 @@ BLOCKHASH_GAS = 20
 MAX_CALL_DEPTH = 1024
 STACK_LIMIT = 1024
 
+# single source of truth for the consensus code-size caps lives in params
+from ..params import MAX_CODE_SIZE, MAX_INIT_CODE_SIZE  # noqa: E402,F401
+
 # coreth native-asset precompile costs (params/protocol_params.go AssetCall*)
 ASSET_BALANCE_APRICOT = 2474
 ASSET_CALL_APRICOT = 30275

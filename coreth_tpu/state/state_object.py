@@ -19,6 +19,8 @@ from ..native import keccak256
 from ..trie.node import EMPTY_ROOT
 from .account import EMPTY_CODE_HASH, Account, normalize_coin_id
 
+RIPEMD_ADDR = (b"\x00" * 19) + b"\x03"  # journal.go touchChange special case
+
 ZERO32 = b"\x00" * 32
 
 
@@ -168,6 +170,13 @@ class StateObject:
 
     def touch(self) -> None:
         self._db.journal.append(_revert_touch(self.address), self.address)
+        if self.address == RIPEMD_ADDR:
+            # journal.go touchChange: the ripemd account stays in the dirty
+            # set even when its touch is reverted (the 2016 consensus quirk);
+            # an extra dirty count makes the revert's decrement a no-op
+            self._db.journal.dirties[self.address] = (
+                self._db.journal.dirties.get(self.address, 0) + 1
+            )
 
     # ----------------------------------------------------------- multicoin
 

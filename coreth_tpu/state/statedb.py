@@ -24,7 +24,7 @@ from .database import Database
 from .journal import Journal
 from .state_object import ZERO32, StateObject
 
-RIPEMD_ADDR = (b"\x00" * 19) + b"\x03"  # the infamous touched-ripemd account
+from .state_object import RIPEMD_ADDR  # noqa: F401  (journal touch quirk)
 
 
 class Log:
@@ -228,6 +228,9 @@ class StateDB:
 
     # -------------------------------------------------------------- refunds
 
+    def get_refund(self) -> int:
+        return self.refund
+
     def add_refund(self, gas: int) -> None:
         prev = self.refund
         self.journal.append(_revert_refund(prev))
@@ -403,6 +406,11 @@ class StateDB:
             self._snap_destructs, self._snap_accounts, self._snap_storage = (
                 set(), {}, {},
             )
+            self.snap = self.snaps.snapshot(root)
+        # subsequent commits diff against the new root (geth statedb.Commit);
+        # our Trie freezes after commit, so reopen it from the forest
+        self.original_root = root
+        self.trie = self.db.open_trie(root)
         return root
 
     def copy(self) -> "StateDB":

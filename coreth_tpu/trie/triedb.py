@@ -208,13 +208,13 @@ class TrieDatabase:
             c = self._dirties.pop(node_hash)
             self._dirty_size -= len(c.blob) + 32
             batch.put(node_hash, c.blob)
-            # keep refcounts consistent for any still-dirty children (FIFO
-            # order normally flushes children first, but re-inserted nodes
-            # can break that): a flushed parent no longer pins them
-            for child in _child_hashes(c.blob):
-                cc = self._dirties.get(child)
-                if cc is not None and cc.parents > 0:
-                    cc.parents -= 1
+            # Deliberately do NOT decrement refcounts of still-dirty
+            # children: a re-inserted child can sit later in FIFO than a
+            # flushed parent, and dropping its pin would let a future GC
+            # delete it before it is ever written — leaving the on-disk
+            # parent pointing at a missing node. Retaining the count leaks
+            # (node stays dirty until a later cap/commit writes it) but can
+            # never lose data — the same trade the reference hashdb makes.
         batch.write()
 
     @property

@@ -1,0 +1,552 @@
+"""EVM interpreter + precompile tests (modeled on the reference's
+core/vm/instructions_test.go, contracts_test.go, runtime tests)."""
+
+import pytest
+
+from coreth_tpu import params, vmerrs
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.evm import opcodes as OP
+from coreth_tpu.evm.evm import EVM, BlockContext, Config, TxContext
+from coreth_tpu.native import keccak256
+from coreth_tpu.state.database import Database
+from coreth_tpu.state.statedb import StateDB
+from coreth_tpu.trie.triedb import TrieDatabase
+
+A1 = b"\xaa" * 20
+A2 = b"\xbb" * 20
+ORIGIN = b"\xcc" * 20
+
+EMPTY_ROOT = bytes.fromhex(
+    "56e81f171bcc55a6ff8345e692c0f86e5b48e01b996cadc001622fb5e363b421"
+)
+
+
+def fresh_state():
+    return StateDB(EMPTY_ROOT, Database(TrieDatabase(MemoryDB())))
+
+
+def make_evm(state=None, cfg=None, time=0, base_fee=None, number=0):
+    state = state or fresh_state()
+    bctx = BlockContext(block_number=number, time=time, base_fee=base_fee)
+    e = EVM(bctx, TxContext(origin=ORIGIN, gas_price=1), state,
+            cfg or params.TEST_CHAIN_CONFIG)
+    return e
+
+
+def push(v: int) -> bytes:
+    """Smallest PUSH for v."""
+    if v == 0:
+        data = b"\x00"
+    else:
+        data = v.to_bytes((v.bit_length() + 7) // 8, "big")
+    return bytes([OP.PUSH1 + len(data) - 1]) + data
+
+
+def mstore_ret(code_prefix: bytes) -> bytes:
+    """Store top-of-stack at mem[0], return 32 bytes."""
+    return code_prefix + push(0) + bytes([OP.MSTORE]) + push(32) + push(0) + bytes([OP.RETURN])
+
+
+def run_code(code: bytes, evm=None, gas=1_000_000, value=0, input_=b"") -> bytes:
+    evm = evm or make_evm()
+    evm.statedb.create_account(A1)
+    evm.statedb.set_code(A1, code)
+    evm.statedb.add_balance(ORIGIN, 10**18)
+    evm.statedb.prepare(evm.rules, ORIGIN, b"\x00" * 20, A1,
+                        list(evm.precompiles.keys()), [])
+    ret, left, err = evm.call(ORIGIN, A1, input_, gas, value)
+    if err is not None:
+        raise err
+    return ret
+
+
+class TestArithmetic:
+    @pytest.mark.parametrize("a,b,op,expect", [
+        (3, 4, OP.ADD, 7),
+        (2**256 - 1, 1, OP.ADD, 0),
+        (5, 6, OP.MUL, 30),
+        (4, 10, OP.SUB, 6),            # SUB pops top (10) as minuend: 10-4
+        (7, 2, OP.EXP, 128),           # 2^7
+    ])
+    def test_binary(self, a, b, op, expect):
+        # stack order: second push is top; SUB computes top - next = b - a
+        code = mstore_ret(push(a) + push(b) + bytes([op]))
+        out = run_code(code)
+        assert int.from_bytes(out, "big") == expect
+
+    def test_sdiv_negative(self):
+        neg7 = (1 << 256) - 7
+        code = mstore_ret(push(2) + push(neg7) + bytes([OP.SDIV]))
+        assert int.from_bytes(run_code(code), "big") == (1 << 256) - 3  # -7/2 = -3
+
+    def test_smod_sign_of_dividend(self):
+        neg7 = (1 << 256) - 7
+        code = mstore_ret(push(3) + push(neg7) + bytes([OP.SMOD]))
+        assert int.from_bytes(run_code(code), "big") == (1 << 256) - 1  # -7 % 3 = -1
+
+    def test_addmod_mulmod(self):
+        code = mstore_ret(push(8) + push(5) + push(6) + bytes([OP.ADDMOD]))
+        assert int.from_bytes(run_code(code), "big") == 3  # (6+5)%8
+        code = mstore_ret(push(8) + push(5) + push(6) + bytes([OP.MULMOD]))
+        assert int.from_bytes(run_code(code), "big") == 6  # 30%8
+
+    def test_signextend(self):
+        code = mstore_ret(push(0xFF) + push(0) + bytes([OP.SIGNEXTEND]))
+        assert int.from_bytes(run_code(code), "big") == 2**256 - 1
+
+    def test_byte_shifts(self):
+        code = mstore_ret(push(0xABCD) + push(30) + bytes([OP.BYTE]))
+        assert int.from_bytes(run_code(code), "big") == 0xAB
+        code = mstore_ret(push(1) + push(255) + bytes([OP.SHL]))
+        assert int.from_bytes(run_code(code), "big") == 1 << 255
+        neg = (1 << 256) - 16
+        code = mstore_ret(push(neg) + push(2) + bytes([OP.SAR][:1]))
+        # SAR: value neg, shift 2 → -4
+        code = mstore_ret(push(2) + push(neg)[0:0] + push(neg) + bytes([OP.SWAP1, OP.SAR]))
+        out = run_code(mstore_ret(push(neg) + push(2) + bytes([OP.SWAP1])[0:0] + bytes([OP.SAR])))
+        # stack: [neg, 2]; SAR pops shift=2, value=neg → -4
+        assert int.from_bytes(out, "big") == (1 << 256) - 4
+
+
+class TestStorageAndMemory:
+    def test_sstore_sload(self):
+        code = (
+            push(0x42) + push(1) + bytes([OP.SSTORE])
+            + mstore_ret(push(1) + bytes([OP.SLOAD]))
+        )
+        assert int.from_bytes(run_code(code), "big") == 0x42
+
+    def test_transient_isolation_not_enabled(self):
+        # TLOAD/TSTORE are NOT in the coreth v0.12.5 jump tables
+        code = push(1) + push(1) + bytes([OP.TSTORE])
+        with pytest.raises(vmerrs.VMError):
+            run_code(code)
+
+    def test_mstore8_msize(self):
+        code = mstore_ret(push(0xABCD) + push(5) + bytes([OP.MSTORE8]) + push(5) + bytes([OP.MLOAD]))
+        out = run_code(code)
+        # mem[5] = 0xCD; MLOAD(5) reads bytes 5..36 → 0xCD << 248
+        assert out[0] == 0xCD
+
+    def test_keccak256_op(self):
+        code = mstore_ret(
+            push(0xDEADBEEF) + push(0) + bytes([OP.MSTORE])
+            + push(32) + push(0) + bytes([OP.KECCAK256])
+        )
+        expect = keccak256((0xDEADBEEF).to_bytes(32, "big"))
+        assert run_code(code) == expect
+
+
+class TestControlFlow:
+    def test_jump_jumpi(self):
+        # jump over an INVALID to a JUMPDEST
+        code = (
+            push(4) + bytes([OP.JUMP, OP.INVALID, OP.JUMPDEST])
+            + mstore_ret(push(7))
+        )
+        assert int.from_bytes(run_code(code), "big") == 7
+
+    def test_invalid_jump(self):
+        code = push(3) + bytes([OP.JUMP, OP.STOP])
+        with pytest.raises(vmerrs.VMError):
+            run_code(code)
+
+    def test_jumpdest_inside_push_data_invalid(self):
+        # PUSH2 0x5B5B then JUMP to offset 1 (inside push data) must fail
+        code = bytes([OP.PUSH1 + 1, OP.JUMPDEST, OP.JUMPDEST]) + push(1) + bytes([OP.JUMP])
+        with pytest.raises(vmerrs.VMError):
+            run_code(code)
+
+    def test_revert_with_reason(self):
+        code = (
+            push(0xBAD) + push(0) + bytes([OP.MSTORE])
+            + push(32) + push(0) + bytes([OP.REVERT])
+        )
+        evm = make_evm()
+        evm.statedb.set_code(A1, code)
+        ret, left, err = evm.call(ORIGIN, A1, b"", 100_000, 0)
+        assert vmerrs.is_revert(err)
+        assert int.from_bytes(ret, "big") == 0xBAD
+        assert left > 0  # revert refunds remaining gas
+
+    def test_out_of_gas_consumes_all(self):
+        code = push(1) + push(1) + bytes([OP.SSTORE])
+        evm = make_evm()
+        evm.statedb.set_code(A1, code)
+        ret, left, err = evm.call(ORIGIN, A1, b"", 5_000, 0)
+        assert err is not None and not vmerrs.is_revert(err)
+        assert left == 0
+
+
+class TestEnvironment:
+    def test_address_caller_origin(self):
+        code = mstore_ret(bytes([OP.ADDRESS]))
+        assert run_code(code)[12:] == A1
+        code = mstore_ret(bytes([OP.CALLER]))
+        assert run_code(code)[12:] == ORIGIN
+        code = mstore_ret(bytes([OP.ORIGIN]))
+        assert run_code(code)[12:] == ORIGIN
+
+    def test_chainid_basefee_number_timestamp(self):
+        evm = make_evm(base_fee=25 * 10**9, time=1234, number=7)
+        assert int.from_bytes(run_code(mstore_ret(bytes([OP.CHAINID])), evm), "big") == 43112
+        evm = make_evm(base_fee=25 * 10**9, time=1234, number=7)
+        assert int.from_bytes(run_code(mstore_ret(bytes([OP.BASEFEE])), evm), "big") == 25 * 10**9
+        evm = make_evm(base_fee=None, time=1234, number=7)
+        assert int.from_bytes(run_code(mstore_ret(bytes([OP.NUMBER])), evm), "big") == 7
+        evm = make_evm(time=1234)
+        assert int.from_bytes(run_code(mstore_ret(bytes([OP.TIMESTAMP])), evm), "big") == 1234
+
+    def test_calldata(self):
+        code = mstore_ret(push(0) + bytes([OP.CALLDATALOAD]))
+        out = run_code(code, input_=b"\x11" * 8)
+        assert out == b"\x11" * 8 + b"\x00" * 24
+        code = mstore_ret(bytes([OP.CALLDATASIZE]))
+        assert int.from_bytes(run_code(code, input_=b"xyz"), "big") == 3
+
+    def test_selfbalance_callvalue(self):
+        evm = make_evm()
+        evm.statedb.add_balance(ORIGIN, 10**18)
+        evm.statedb.set_code(A1, mstore_ret(bytes([OP.SELFBALANCE])))
+        ret, _, err = evm.call(ORIGIN, A1, b"", 100_000, 777)
+        assert err is None
+        assert int.from_bytes(ret, "big") == 777
+
+
+class TestCalls:
+    def _deploy_echo(self, evm):
+        """A2: returns its calldata."""
+        # CALLDATACOPY(0,0,CALLDATASIZE); RETURN(0, CALLDATASIZE)
+        code = (
+            bytes([OP.CALLDATASIZE]) + push(0) + push(0) + bytes([OP.CALLDATACOPY])
+            + bytes([OP.CALLDATASIZE]) + push(0) + bytes([OP.RETURN])
+        )
+        evm.statedb.set_code(A2, code)
+
+    def test_call_and_returndata(self):
+        evm = make_evm()
+        self._deploy_echo(evm)
+        # A1 calls A2 with 4 bytes of data, copies returndata out
+        a2_int = int.from_bytes(A2, "big")
+        code = (
+            push(0xCAFEBABE) + push(0) + bytes([OP.MSTORE])
+            # CALL(gas, A2, 0, in_off=28, in_size=4, out=64, out_size=4)
+            + push(4) + push(64) + push(4) + push(28) + push(0) + push(a2_int)
+            + push(50_000) + bytes([OP.CALL])
+            + bytes([OP.POP])
+            + push(32) + push(64) + bytes([OP.RETURN])
+        )
+        out = run_code(code, evm)
+        assert out[:4] == bytes.fromhex("cafebabe")
+
+    def test_staticcall_blocks_sstore(self):
+        evm = make_evm()
+        evm.statedb.set_code(A2, push(1) + push(1) + bytes([OP.SSTORE]))
+        a2 = int.from_bytes(A2, "big")
+        code = mstore_ret(
+            push(0) + push(0) + push(0) + push(0) + push(a2) + push(50_000)
+            + bytes([OP.STATICCALL])
+        )
+        assert int.from_bytes(run_code(code, evm), "big") == 0  # inner failed
+
+    def test_value_transfer_via_call(self):
+        evm = make_evm()
+        evm.statedb.add_balance(ORIGIN, 10**18)
+        evm.statedb.set_code(A1, b"")  # plain transfer
+        ret, left, err = evm.call(ORIGIN, A2, b"", 50_000, 12345)
+        assert err is None
+        assert evm.statedb.get_balance(A2) == 12345
+
+    def test_delegatecall_preserves_context(self):
+        evm = make_evm()
+        # A2's code stores CALLER at slot 0 of the *calling* contract
+        evm.statedb.set_code(A2, bytes([OP.CALLER]) + push(0) + bytes([OP.SSTORE]))
+        a2 = int.from_bytes(A2, "big")
+        code = (
+            push(0) + push(0) + push(0) + push(0) + push(a2) + push(100_000)
+            + bytes([OP.DELEGATECALL, OP.POP, OP.STOP])
+        )
+        run_code(code, evm)
+        stored = evm.statedb.get_state(A1, (0).to_bytes(32, "big"))
+        assert stored[12:] == ORIGIN  # caller seen by delegated code = A1's caller
+
+
+class TestCreate:
+    def test_create_deploys(self):
+        evm = make_evm()
+        # init code returns 2 bytes of runtime code (0x6001 → PUSH1 1)
+        runtime = bytes([OP.PUSH1, 0x01])
+        init = (
+            push(int.from_bytes(runtime.ljust(32, b"\x00"), "big"))
+            + push(0) + bytes([OP.MSTORE])
+            + push(2) + push(0) + bytes([OP.RETURN])
+        )
+        # A1: CREATE with init code in memory
+        store_init = b"".join(
+            push(int.from_bytes(init[i:i+32].ljust(32, b"\x00"), "big"))
+            + push(i) + bytes([OP.MSTORE])
+            for i in range(0, len(init), 32)
+        )
+        code = mstore_ret(store_init + push(len(init)) + push(0) + push(0) + bytes([OP.CREATE]))
+        out = run_code(code, evm, gas=2_000_000)
+        created = out[12:]
+        assert created != b"\x00" * 20
+        assert evm.statedb.get_code(created) == runtime
+        assert evm.statedb.get_nonce(created) == 1  # EIP-158
+
+    def test_create_ef_rejected_ap3(self):
+        evm = make_evm()
+        # init code returns 1 byte 0xEF
+        init = (
+            push(0xEF << 248) + push(0) + bytes([OP.MSTORE])
+            + push(1) + push(0) + bytes([OP.RETURN])
+        )
+        store = b"".join(
+            push(int.from_bytes(init[i:i+32].ljust(32, b"\x00"), "big"))
+            + push(i) + bytes([OP.MSTORE]) for i in range(0, len(init), 32)
+        )
+        code = mstore_ret(store + push(len(init)) + push(0) + push(0) + bytes([OP.CREATE]))
+        out = run_code(code, evm, gas=2_000_000)
+        assert int.from_bytes(out, "big") == 0  # creation failed
+
+
+class TestGasAccounting:
+    def test_berlin_cold_warm_sload(self):
+        """Cold SLOAD 2100, warm 100 (EIP-2929 under AP2)."""
+        evm = make_evm()
+        evm.statedb.set_code(A1, bytes([OP.PUSH1, 1, OP.SLOAD, OP.POP,
+                                        OP.PUSH1, 1, OP.SLOAD, OP.POP, OP.STOP]))
+        evm.statedb.prepare(evm.rules, ORIGIN, b"\x00" * 20, A1,
+                            list(evm.precompiles.keys()), [])
+        gas = 100_000
+        ret, left, err = evm.call(ORIGIN, A1, b"", gas, 0)
+        assert err is None
+        used = gas - left
+        # 2×PUSH1(3) + 2×POP(2) + cold 2100 + warm 100
+        assert used == 3 + 2100 + 2 + 3 + 100 + 2
+
+    def test_sstore_no_refund_post_ap1(self):
+        """AP1 removed SSTORE refunds: clearing a slot refunds nothing."""
+        evm = make_evm()
+        key = (1).to_bytes(32, "big")
+        evm.statedb.set_state(A1, key, (5).to_bytes(32, "big"))
+        evm.statedb.set_code(A1, push(0) + push(1) + bytes([OP.SSTORE, OP.STOP]))
+        evm.statedb.prepare(evm.rules, ORIGIN, b"\x00" * 20, A1,
+                            list(evm.precompiles.keys()), [])
+        ret, left, err = evm.call(ORIGIN, A1, b"", 100_000, 0)
+        assert err is None
+        assert evm.statedb.get_refund() == 0
+
+
+class TestPrecompiles:
+    def _call_precompile(self, addr20: bytes, input_: bytes, evm=None, gas=10_000_000):
+        evm = evm or make_evm()
+        evm.statedb.add_balance(ORIGIN, 10**18)
+        evm.statedb.prepare(evm.rules, ORIGIN, b"\x00" * 20, addr20,
+                            list(evm.precompiles.keys()), [])
+        ret, left, err = evm.call(ORIGIN, addr20, input_, gas, 0)
+        return ret, err
+
+    def test_sha256_identity_ripemd(self):
+        import hashlib
+
+        out, err = self._call_precompile((b"\x00" * 19) + b"\x02", b"abc")
+        assert err is None and out == hashlib.sha256(b"abc").digest()
+        out, err = self._call_precompile((b"\x00" * 19) + b"\x04", b"hello")
+        assert err is None and out == b"hello"
+        out, err = self._call_precompile((b"\x00" * 19) + b"\x03", b"abc")
+        assert err is None
+        assert out[12:].hex() == "8eb208f7e05d987a9b044a8e98c6b087f15a0bfc"
+
+    def test_ecrecover(self):
+        from coreth_tpu.crypto.secp256k1 import priv_to_address, sign
+
+        priv = b"\x11" * 32
+        h = keccak256(b"message")
+        v, r, s = sign(h, priv)
+        input_ = h + (v + 27).to_bytes(32, "big") + r.to_bytes(32, "big") + s.to_bytes(32, "big")
+        out, err = self._call_precompile((b"\x00" * 19) + b"\x01", input_)
+        assert err is None
+        assert out[12:] == priv_to_address(priv)
+
+    def test_modexp(self):
+        # 3^5 mod 7 = 5
+        inp = (
+            (1).to_bytes(32, "big") + (1).to_bytes(32, "big") + (1).to_bytes(32, "big")
+            + b"\x03" + b"\x05" + b"\x07"
+        )
+        out, err = self._call_precompile((b"\x00" * 19) + b"\x05", inp)
+        assert err is None and out == b"\x05"
+
+    def test_bn256_add(self):
+        # G + G = 2G (known vector)
+        g = (1).to_bytes(32, "big") + (2).to_bytes(32, "big")
+        out, err = self._call_precompile((b"\x00" * 19) + b"\x06", g + g)
+        assert err is None
+        assert out[:32].hex() == "030644e72e131a029b85045b68181585d97816a916871ca8d3c208c16d87cfd3"
+
+    def test_bn256_pairing_trivial(self):
+        # empty input → success (true)
+        out, err = self._call_precompile((b"\x00" * 19) + b"\x08", b"")
+        assert err is None and int.from_bytes(out, "big") == 1
+
+    def test_blake2f_vector(self):
+        # EIP-152 test vector 5
+        inp = (
+            (12).to_bytes(4, "big")
+            + bytes.fromhex(
+                "48c9bdf267e6096a3ba7ca8485ae67bb2bf894fe72f36e3cf1361d5f3af54fa5"
+                "d182e6ad7f520e511f6c3e2b8c68059b6bbd41fbabd9831f79217e1319cde05b"
+            )
+            + b"abc".ljust(128, b"\x00")
+            + (3).to_bytes(8, "little") + (0).to_bytes(8, "little")
+            + b"\x01"
+        )
+        assert len(inp) == 213
+        out, err = self._call_precompile((b"\x00" * 19) + b"\x09", inp)
+        assert err is None
+        assert out.hex() == (
+            "ba80a53f981c4d0d6a2797b69f12f6e94c212f14685ac4b74b12bb6fdbffa2d1"
+            "7d87c5392aab792dc252d5de4533cc9518d38aa8dbf1925ab92386edd4009923"
+        )
+
+    @staticmethod
+    def _pre_banff_config():
+        cfg = params.avalanche_local_chain_config()
+        cfg.apricot_phase_pre6_time = None
+        cfg.apricot_phase6_time = None
+        cfg.apricot_phase_post6_time = None
+        cfg.banff_time = None
+        cfg.cortina_time = None
+        cfg.d_upgrade_time = None
+        return cfg
+
+    def test_native_asset_balance(self):
+        evm = make_evm(cfg=self._pre_banff_config())
+        coin = b"\x77" * 32
+        evm.statedb.add_balance_multicoin(A1, coin, 424242)
+        from coreth_tpu.evm.precompiles import NATIVE_ASSET_BALANCE_ADDR
+
+        out, err = self._call_precompile(NATIVE_ASSET_BALANCE_ADDR, A1 + coin, evm)
+        assert err is None
+        assert int.from_bytes(out, "big") == 424242
+
+    def test_native_asset_call_transfers(self):
+        evm = make_evm(cfg=self._pre_banff_config())
+        coin = b"\x77" * 32
+        evm.statedb.add_balance_multicoin(ORIGIN, coin, 1000)
+        from coreth_tpu.evm.precompiles import NATIVE_ASSET_CALL_ADDR
+
+        inp = A2 + coin + (400).to_bytes(32, "big") + b""
+        out, err = self._call_precompile(NATIVE_ASSET_CALL_ADDR, inp, evm)
+        assert err is None
+        assert evm.statedb.get_balance_multicoin(A2, coin) == 400
+        assert evm.statedb.get_balance_multicoin(ORIGIN, coin) == 600
+
+    def test_native_asset_deprecated_banff(self):
+        cfg = params.avalanche_local_chain_config()
+        state = fresh_state()
+        evm = make_evm(state=state, cfg=cfg, time=10**10)  # far future: banff active
+        assert evm.rules.is_banff
+        coin = b"\x77" * 32
+        state.add_balance_multicoin(ORIGIN, coin, 1000)
+        from coreth_tpu.evm.precompiles import NATIVE_ASSET_CALL_ADDR
+
+        inp = A2 + coin + (400).to_bytes(32, "big")
+        out, err = self._call_precompile(NATIVE_ASSET_CALL_ADDR, inp, evm)
+        assert vmerrs.is_revert(err)
+        assert state.get_balance_multicoin(A2, coin) == 0
+
+
+class TestStateTransition:
+    def test_apply_message_transfer(self):
+        from coreth_tpu.core.state_transition import GasPool, Message, apply_message
+
+        evm = make_evm(base_fee=25 * 10**9)
+        st = evm.statedb
+        sender = b"\x01" + b"\x22" * 19
+        st.add_balance(sender, 10**18)
+        msg = Message(from_=sender, to=A2, value=1000, gas_limit=21000,
+                      gas_price=25 * 10**9)
+        res = apply_message(evm, msg, GasPool(8_000_000))
+        assert res.err is None
+        assert res.used_gas == 21000
+        assert st.get_balance(A2) == 1000
+        assert st.get_nonce(sender) == 1
+        # fee burned to coinbase (blackhole in production; 0x0 here)
+        assert st.get_balance(sender) == 10**18 - 1000 - 21000 * 25 * 10**9
+
+    def test_nonce_mismatch_rejected(self):
+        from coreth_tpu.core.state_transition import (
+            GasPool, Message, TxValidationError, apply_message,
+        )
+
+        evm = make_evm()
+        sender = b"\x33" * 20
+        evm.statedb.add_balance(sender, 10**18)
+        msg = Message(from_=sender, to=A2, nonce=5, gas_limit=21000, gas_price=1)
+        with pytest.raises(TxValidationError):
+            apply_message(evm, msg, GasPool(8_000_000))
+
+    def test_intrinsic_gas_data(self):
+        from coreth_tpu.core.state_transition import intrinsic_gas
+
+        # 2 nonzero + 3 zero bytes, istanbul: 21000 + 2*16 + 3*4
+        assert intrinsic_gas(b"\x01\x02\x00\x00\x00", [], False, True, True, False) == 21044
+
+    def test_contract_creation_tx(self):
+        from coreth_tpu.core.state_transition import GasPool, Message, apply_message
+        from coreth_tpu.core.types import create_address
+
+        evm = make_evm()
+        sender = b"\x44" * 20
+        evm.statedb.add_balance(sender, 10**18)
+        runtime = bytes([OP.PUSH1, 0x01])
+        init = (
+            push(int.from_bytes(runtime.ljust(32, b"\x00"), "big"))
+            + push(0) + bytes([OP.MSTORE])
+            + push(2) + push(0) + bytes([OP.RETURN])
+        )
+        msg = Message(from_=sender, to=None, data=init, gas_limit=200_000, gas_price=1)
+        res = apply_message(evm, msg, GasPool(8_000_000))
+        assert res.err is None
+        addr = create_address(sender, 0)
+        assert evm.statedb.get_code(addr) == runtime
+
+
+class TestBn256Pairing:
+    """Bilinearity regression tests — the pairing had no coverage before."""
+
+    G1 = (1, 2)
+    G2 = (
+        (10857046999023057135944570762232829481370756359578518086990519993285655852781,
+         11559732032986387107991004021392285783925812861821192530917403151452391805634),
+        (8495653923123431417604973247489272438418190587263600148770280649306958101930,
+         4082367875863433681332203403145435568316851327593401208105741076214120093531),
+    )
+
+    def test_bilinearity(self):
+        from coreth_tpu.evm import bn256 as b
+
+        neg_g1 = (self.G1[0], (-self.G1[1]) % b.P)
+        assert b.pairing_check([(self.G1, self.G2), (neg_g1, self.G2)])
+        two_p = b.g1_add(self.G1, self.G1)
+        two_q = b.g2_add(self.G2, self.G2)
+        assert b.pairing_check([(two_p, self.G2), (neg_g1, two_q)])
+        assert not b.pairing_check([(self.G1, self.G2), (self.G1, self.G2)])
+
+    def test_pairing_precompile_valid_check(self):
+        from coreth_tpu.evm import bn256 as b
+
+        neg_g1 = (self.G1[0], (-self.G1[1]) % b.P)
+        inp = (
+            b.g1_marshal(self.G1)
+            + b.g2_marshal_eip197(self.G2)
+            + b.g1_marshal(neg_g1)
+            + b.g2_marshal_eip197(self.G2)
+        )
+        evm = make_evm()
+        evm.statedb.add_balance(ORIGIN, 10**18)
+        ret, left, err = evm.call(ORIGIN, (b"\x00" * 19) + b"\x08", inp, 10**6, 0)
+        assert err is None
+        assert int.from_bytes(ret, "big") == 1
