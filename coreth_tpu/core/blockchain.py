@@ -1,0 +1,474 @@
+"""Canonical chain management under Snowman consensus (role of
+/root/reference/core/blockchain.go).
+
+The chain has no forks-choice rule of its own: consensus drives it through
+insertBlock (verify+process, core/blockchain.go:1245), Accept
+(core/blockchain.go:1034 → async acceptor queue :563-611), Reject (:1067),
+and SetPreference (:973 → reorg :1424). State commitment flows through the
+TrieWriter policy (state_manager) into the TPU-hashing TrieDatabase.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from .. import rlp
+from ..state.database import Database
+from ..state.statedb import StateDB
+from . import rawdb
+from .state_manager import CappedMemoryTrieWriter, NoPruningTrieWriter
+from .state_processor import StateProcessor
+from .types import Block, Body, Header, Receipt, create_bloom, derive_sha
+
+
+class ChainError(Exception):
+    pass
+
+
+@dataclass
+class CacheConfig:
+    """core.CacheConfig (blockchain.go:150-180) — the knobs that matter."""
+
+    pruning: bool = True
+    commit_interval: int = 4096
+    trie_dirty_limit: int = 256 * 1024 * 1024
+    accepted_cache_size: int = 32
+    snapshot_limit: int = 0  # 0 disables the flat snapshot (Phase 4)
+
+
+class BlockValidator:
+    """core/block_validator.go: body + post-state checks."""
+
+    def __init__(self, config, chain, engine):
+        self.config = config
+        self.chain = chain
+        self.engine = engine
+
+    def validate_body(self, block: Block) -> None:
+        header = block.header
+        if self.chain.has_block_and_state(block.hash(), header.number):
+            raise ChainError("known block")
+        if derive_sha(block.transactions) != header.tx_hash:
+            raise ChainError("transaction root hash mismatch")
+        if block.uncles:
+            raise ChainError("uncles not allowed")
+        if not self.chain.has_block_and_state(header.parent_hash, header.number - 1):
+            raise ChainError("unknown ancestor / pruned ancestor")
+
+    def validate_state(self, block: Block, statedb: StateDB, receipts: List[Receipt],
+                       used_gas: int) -> None:
+        header = block.header
+        if header.gas_used != used_gas:
+            raise ChainError(f"invalid gas used (remote {header.gas_used} local {used_gas})")
+        rbloom = create_bloom(receipts)
+        if rbloom != header.bloom:
+            raise ChainError("invalid bloom")
+        receipt_sha = derive_sha(receipts)
+        if receipt_sha != header.receipt_hash:
+            raise ChainError(
+                f"invalid receipt root (remote {header.receipt_hash.hex()} local {receipt_sha.hex()})"
+            )
+        root = statedb.intermediate_root(self.config.is_eip158(header.number))
+        if root != header.root:
+            raise ChainError(
+                f"invalid merkle root (remote {header.root.hex()} local {root.hex()})"
+            )
+
+
+class BlockChain:
+    def __init__(
+        self,
+        diskdb,
+        cache_config: CacheConfig,
+        config,
+        genesis,
+        engine,
+        state_database: Optional[Database] = None,
+        last_accepted_hash: bytes = b"\x00" * 32,
+    ):
+        from ..trie.triedb import TrieDatabase
+
+        self.diskdb = diskdb
+        self.cache_config = cache_config
+        self.config = config
+        self.engine = engine
+        if state_database is None:
+            state_database = Database(TrieDatabase(diskdb))
+        self.state_database = state_database
+
+        self.chainmu = threading.RLock()
+
+        self._blocks: Dict[bytes, Block] = {}  # block cache by hash
+        self._receipts: Dict[bytes, List[Receipt]] = {}
+        self._canonical: Dict[int, bytes] = {}
+
+        self.processor = StateProcessor(config, self, engine)
+        self.validator = BlockValidator(config, self, engine)
+        if cache_config.pruning:
+            self.trie_writer = CappedMemoryTrieWriter(
+                state_database.triedb,
+                commit_interval=cache_config.commit_interval,
+                memory_cap=cache_config.trie_dirty_limit,
+            )
+        else:
+            self.trie_writer = NoPruningTrieWriter(state_database.triedb)
+
+        # snapshot tree (Phase 4): wired when snapshot_limit > 0
+        self.snaps = None
+
+        # subscription feeds
+        self._chain_feed: List[Callable] = []
+        self._chain_accepted_feed: List[Callable] = []
+        self._logs_feed: List[Callable] = []
+        self._accepted_logs_feed: List[Callable] = []
+
+        # genesis
+        self.genesis_block = self._setup_genesis(genesis)
+
+        self.current_block: Block = self.genesis_block
+        self.last_accepted: Block = self.genesis_block
+
+        # restore pointers if the db has a head
+        head = rawdb.read_head_block_hash(diskdb)
+        if head is not None and head != self.genesis_block.hash():
+            blk = self.get_block(head)
+            if blk is not None:
+                self.current_block = blk
+                self.last_accepted = blk
+
+        if last_accepted_hash != b"\x00" * 32:
+            blk = self.get_block(last_accepted_hash)
+            if blk is None:
+                raise ChainError("last accepted block not found")
+            self.current_block = blk
+            self.last_accepted = blk
+
+        # async acceptor queue (blockchain.go:563-611): decouples consensus
+        # Accept from expensive post-accept work, with backpressure
+        self.acceptor_queue_limit = 64
+        self._acceptor_queue: "queue.Queue[Optional[Block]]" = queue.Queue(
+            self.acceptor_queue_limit
+        )
+        self._acceptor_closed = False
+        self._acceptor_wg = threading.Event()
+        self._acceptor_wg.set()  # empty == set
+        self._acceptor_tip_lock = threading.Lock()
+        self._acceptor_tip: Optional[Block] = None
+        self._acceptor_thread = threading.Thread(
+            target=self._start_acceptor, name="acceptor", daemon=True
+        )
+        self._acceptor_thread.start()
+
+    # ------------------------------------------------------------- genesis
+
+    def _setup_genesis(self, genesis) -> Block:
+        stored = rawdb.read_canonical_hash(self.diskdb, 0)
+        if stored is None:
+            block = genesis.commit(self.diskdb, self.state_database)
+        else:
+            # fail fast on config/database mismatch rather than silently
+            # re-initializing over existing chain data (genesis.go
+            # SetupGenesisBlock mismatch error)
+            expected = genesis.to_block(self.state_database)
+            if expected.hash() != stored:
+                raise ChainError(
+                    f"genesis mismatch: database has {stored.hex()}, "
+                    f"config produces {expected.hash().hex()}"
+                )
+            block = self.get_block(stored)
+            if block is None:
+                raise ChainError("genesis block data missing from database")
+        self._canonical[0] = block.hash()
+        self._blocks[block.hash()] = block
+        return block
+
+    # --------------------------------------------------------------- reads
+
+    def get_block(self, block_hash: bytes) -> Optional[Block]:
+        blk = self._blocks.get(block_hash)
+        if blk is not None:
+            return blk
+        number = rawdb.read_header_number(self.diskdb, block_hash)
+        if number is None:
+            return None
+        return self.get_block_by_number_and_hash(number, block_hash)
+
+    def get_block_by_number_and_hash(self, number: int, block_hash: bytes) -> Optional[Block]:
+        hdr_rlp = rawdb.read_header_rlp(self.diskdb, number, block_hash)
+        body_rlp = rawdb.read_body_rlp(self.diskdb, number, block_hash)
+        if hdr_rlp is None or body_rlp is None:
+            return None
+        header = Header.decode(hdr_rlp)
+        items = rlp.decode(body_rlp)
+        from .types import Transaction
+
+        txs = []
+        for ti in items[0]:
+            txs.append(
+                Transaction.decode(rlp.encode(ti) if isinstance(ti, list) else ti)
+            )
+        uncles = [Header.from_items(u) for u in items[1]]
+        version = int.from_bytes(items[2], "big") if isinstance(items[2], bytes) else items[2]
+        ext = items[3] if len(items) > 3 and items[3] != b"" else None
+        blk = Block(header, txs, uncles, version, ext)
+        self._blocks[block_hash] = blk
+        return blk
+
+    def get_block_by_number(self, number: int) -> Optional[Block]:
+        h = self.get_canonical_hash(number)
+        if h is None:
+            return None
+        return self.get_block(h)
+
+    def get_canonical_hash(self, number: int) -> Optional[bytes]:
+        h = self._canonical.get(number)
+        if h is not None:
+            return h
+        return rawdb.read_canonical_hash(self.diskdb, number)
+
+    def get_header(self, block_hash: bytes) -> Optional[Header]:
+        blk = self.get_block(block_hash)
+        return blk.header if blk is not None else None
+
+    def get_receipts(self, block_hash: bytes) -> Optional[List[Receipt]]:
+        cached = self._receipts.get(block_hash)
+        if cached is not None:
+            return cached
+        number = rawdb.read_header_number(self.diskdb, block_hash)
+        if number is None:
+            return None
+        blob = rawdb.read_receipts_rlp(self.diskdb, number, block_hash)
+        if blob is None:
+            return None
+        items = rlp.decode(blob)
+        receipts = [Receipt.decode(r) for r in items]
+        # stored receipts hold only consensus fields; rederive the rest
+        # (types.deriveReceiptFields — tx hash, gas used, contract addr…)
+        block = self.get_block(block_hash)
+        if block is not None:
+            from .types import Signer, derive_receipt_fields
+
+            derive_receipt_fields(
+                receipts, block.transactions, block_hash, number,
+                block.base_fee, Signer(self.config.chain_id),
+            )
+        self._receipts[block_hash] = receipts
+        return receipts
+
+    def has_block(self, block_hash: bytes) -> bool:
+        return self.get_block(block_hash) is not None
+
+    def has_state(self, root: bytes) -> bool:
+        from ..trie.node import EMPTY_ROOT
+
+        if root == EMPTY_ROOT:
+            return True
+        return root in self.state_database.triedb or (
+            self.diskdb.get(root) is not None
+        )
+
+    def has_block_and_state(self, block_hash: bytes, number: int) -> bool:
+        blk = self.get_block(block_hash)
+        if blk is None:
+            return False
+        return self.has_state(blk.root)
+
+    def state_at(self, root: bytes) -> StateDB:
+        return StateDB(root, self.state_database, self.snaps)
+
+    def state(self) -> StateDB:
+        return self.state_at(self.current_block.root)
+
+    # -------------------------------------------------------------- insert
+
+    def insert_block(self, block: Block) -> None:
+        """InsertBlockManual(writes=True) (blockchain.go:1234-1389)."""
+        with self.chainmu:
+            self._insert_block(block, writes=True)
+
+    def insert_block_manual(self, block: Block, writes: bool) -> None:
+        with self.chainmu:
+            self._insert_block(block, writes)
+
+    def _insert_block(self, block: Block, writes: bool) -> None:
+        header = block.header
+        parent = self.get_header(header.parent_hash)
+        if parent is None:
+            raise ChainError("unknown ancestor")
+
+        self.engine.verify_header(self.config, header, parent)
+        self.validator.validate_body(block)
+
+        statedb = self.state_at(parent.root)
+
+        receipts, logs, used_gas = self.processor.process(block, parent, statedb)
+        self.validator.validate_state(block, statedb, receipts, used_gas)
+
+        if not writes:
+            return
+
+        # commit state: trie refs live until Accept/Reject balance them
+        root = statedb.commit(self.config.is_eip158(header.number))
+        if root != header.root:
+            raise ChainError("commit root mismatch")
+        self.trie_writer.insert_trie(block)
+
+        self._write_block(block, receipts)
+
+        # new tip if it extends the current preference
+        if block.parent_hash == self.current_block.hash():
+            self._write_canonical(block)
+
+        for fn in self._chain_feed:
+            fn(block, logs)
+
+    def _write_block(self, block: Block, receipts: List[Receipt]) -> None:
+        h = block.hash()
+        n = block.number
+        self._blocks[h] = block
+        self._receipts[h] = receipts
+        rawdb.write_header_number(self.diskdb, h, n)
+        rawdb.write_header_rlp(self.diskdb, n, h, block.header.encode())
+        body_items = [
+            [rlp.decode(t.encode()) if t.type == 0 else t.encode() for t in block.transactions],
+            [u.rlp_items() for u in block.uncles],
+            block.version,
+            block.ext_data if block.ext_data is not None else b"",
+        ]
+        rawdb.write_body_rlp(self.diskdb, n, h, rlp.encode(body_items))
+        rawdb.write_receipts_rlp(
+            self.diskdb, n, h, rlp.encode([r.encode() for r in receipts])
+        )
+
+    def _write_canonical(self, block: Block) -> None:
+        self._canonical[block.number] = block.hash()
+        rawdb.write_canonical_hash(self.diskdb, block.hash(), block.number)
+        rawdb.write_head_block_hash(self.diskdb, block.hash())
+        self.current_block = block
+
+    # ------------------------------------------------------ accept / reject
+
+    def accept(self, block: Block) -> None:
+        """Accept (blockchain.go:1034-1065): reorg to the accepted block if
+        it is not canonical, then enqueue async post-processing."""
+        with self.chainmu:
+            canonical = self.get_canonical_hash(block.number)
+            if canonical != block.hash():
+                self._set_preference_locked(block)
+            self.last_accepted = block
+            with self._acceptor_tip_lock:
+                self._acceptor_tip = block
+            self._acceptor_wg.clear()
+            # enqueue under chainmu so concurrent accepts cannot reorder the
+            # queue relative to the pointer updates (blockchain.go:1061)
+            self._acceptor_queue.put(block)
+
+    def reject(self, block: Block) -> None:
+        """Reject (blockchain.go:1067-1094): drop refs for the losing block."""
+        with self.chainmu:
+            self.trie_writer.reject_trie(block)
+            self._blocks.pop(block.hash(), None)
+            self._receipts.pop(block.hash(), None)
+
+    def _start_acceptor(self) -> None:
+        while True:
+            block = self._acceptor_queue.get()
+            if block is None:
+                return
+            try:
+                self._accept_post_process(block)
+            finally:
+                self._acceptor_queue.task_done()
+                if self._acceptor_queue.empty():
+                    self._acceptor_wg.set()
+
+    def _accept_post_process(self, block: Block) -> None:
+        """startAcceptor body (blockchain.go:563-611)."""
+        if self.snaps is not None:
+            self.snaps.flatten(block.hash())
+        self.trie_writer.accept_trie(block)
+        for i, tx in enumerate(block.transactions):
+            rawdb.write_tx_lookup(self.diskdb, tx.hash(), block.number)
+        receipts = self.get_receipts(block.hash()) or []
+        logs = [l for r in receipts for l in r.logs]
+        for fn in self._chain_accepted_feed:
+            fn(block, logs)
+        with self._acceptor_tip_lock:
+            if self._acceptor_tip is block:
+                self._acceptor_tip = None
+
+    def drain_acceptor_queue(self) -> None:
+        """Block until all queued Accepts have been post-processed."""
+        self._acceptor_queue.join()
+        self._acceptor_wg.set()
+
+    # ----------------------------------------------------- preference/reorg
+
+    def set_preference(self, block: Block) -> None:
+        """SetPreference (blockchain.go:973-1012)."""
+        with self.chainmu:
+            self._set_preference_locked(block)
+
+    def _set_preference_locked(self, block: Block) -> None:
+        if block.hash() == self.current_block.hash():
+            return
+        self._reorg(self.current_block, block)
+
+    def _reorg(self, old_head: Block, new_head: Block) -> None:
+        """reorg (blockchain.go:1424+): rewind canonical mappings to the
+        common ancestor, then write the new chain's canonical pointers."""
+        new_chain = []
+        old, new = old_head, new_head
+        while new.number > old.number:
+            new_chain.append(new)
+            parent = self.get_block(new.parent_hash)
+            if parent is None:
+                raise ChainError("reorg: missing new-chain parent")
+            new = parent
+        while old.number > new.number:
+            parent = self.get_block(old.parent_hash)
+            if parent is None:
+                raise ChainError("reorg: missing old-chain parent")
+            old = parent
+        while old.hash() != new.hash():
+            new_chain.append(new)
+            old_p = self.get_block(old.parent_hash)
+            new_p = self.get_block(new.parent_hash)
+            if old_p is None or new_p is None:
+                raise ChainError("reorg: missing common ancestor")
+            old, new = old_p, new_p
+        # delete canonical entries above the fork point on the old chain
+        for num in range(new.number + 1, old_head.number + 1):
+            self._canonical.pop(num, None)
+            rawdb.delete_canonical_hash(self.diskdb, num)
+        for blk in reversed(new_chain):
+            self._canonical[blk.number] = blk.hash()
+            rawdb.write_canonical_hash(self.diskdb, blk.hash(), blk.number)
+        self.current_block = new_head
+        rawdb.write_head_block_hash(self.diskdb, new_head.hash())
+
+    # -------------------------------------------------------------- events
+
+    def subscribe_chain_event(self, fn: Callable) -> None:
+        self._chain_feed.append(fn)
+
+    def subscribe_chain_accepted_event(self, fn: Callable) -> None:
+        self._chain_accepted_feed.append(fn)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def stop(self) -> None:
+        self.drain_acceptor_queue()
+        self._acceptor_queue.put(None)
+        self._acceptor_thread.join(timeout=5)
+        self.trie_writer.shutdown()
+
+    def last_accepted_block(self) -> Block:
+        return self.last_accepted
+
+    def last_consensus_accepted_block(self) -> Block:
+        with self.chainmu:
+            return self.last_accepted
