@@ -299,6 +299,12 @@ class BlockChain:
         if parent is None:
             raise ChainError("unknown ancestor")
 
+        # overlap sender ecrecover with verification (blockchain.go:1247)
+        from .sender_cacher import sender_cacher
+        from .types import Signer
+
+        sender_cacher.recover(Signer(self.config.chain_id), block.transactions)
+
         self.engine.verify_header(self.config, header, parent)
         self.validator.validate_body(block)
 
@@ -318,12 +324,13 @@ class BlockChain:
 
         self._write_block(block, receipts)
 
-        # new tip if it extends the current preference
+        # new tip if it extends the current preference; the chain feed only
+        # fires for head changes — non-canonical siblings must not reset
+        # the tx pool onto a losing fork
         if block.parent_hash == self.current_block.hash():
             self._write_canonical(block)
-
-        for fn in self._chain_feed:
-            fn(block, logs)
+            for fn in self._chain_feed:
+                fn(block, logs)
 
     def _write_block(self, block: Block, receipts: List[Receipt]) -> None:
         h = block.hash()

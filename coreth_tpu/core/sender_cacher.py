@@ -1,0 +1,67 @@
+"""Background batched sender recovery (role of /root/reference/core/
+sender_cacher.go).
+
+The reference fans ecrecover across N goroutines with a strided split
+(sender_cacher.go:88-115). Here the seam is batch-first: recover() takes
+the whole tx slice and dispatches to a pluggable batch recoverer — the
+C++ keccak path covers the hashing; the secp256k1 scalar work stays on
+CPU (BASELINE.json config #3 keeps verification host-side). A thread pool
+overlaps recovery with block execution.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+from .types import Signer, Transaction
+
+
+class TxSenderCacher:
+    def __init__(self, threads: int = 4, batch_recover=None):
+        self._pool = ThreadPoolExecutor(max_workers=max(threads, 1))
+        self._batch_recover = batch_recover
+        self._lock = threading.Lock()
+        self._futures: list = []
+
+    def recover(self, signer: Signer, txs: List[Transaction]) -> None:
+        """Kick off sender recovery for txs; results land in each tx's
+        _sender cache so later Sender() calls are free."""
+        if not txs:
+            return
+        # prune finished futures so the fire-and-forget path stays bounded
+        with self._lock:
+            self._futures = [f for f in self._futures if not f.done()]
+        if self._batch_recover is not None:
+            fut = self._pool.submit(self._batch_recover, signer, txs)
+            self._futures.append(fut)
+            return
+
+        def work(chunk):
+            for tx in chunk:
+                try:
+                    signer.sender(tx)  # caches tx._sender
+                except Exception:
+                    pass
+
+        # strided split like the reference (sender_cacher.go:100-108)
+        n = min(4, len(txs))
+        for i in range(n):
+            self._futures.append(self._pool.submit(work, txs[i::n]))
+
+    def recover_from_block(self, signer: Signer, block) -> None:
+        self.recover(signer, block.transactions)
+
+    def wait(self) -> None:
+        with self._lock:
+            futures, self._futures = self._futures, []
+        for f in futures:
+            f.result()
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
+# module-level shared cacher (core/sender_cacher.go txSenderCacher singleton)
+sender_cacher = TxSenderCacher()

@@ -1,0 +1,303 @@
+"""Transaction pool (role of /root/reference/core/txpool/txpool.go +
+list.go/noncer.go — pending/queued partition, per-account nonce lists,
+price-bounded admission, head-event reset).
+
+The reference runs a goroutine event loop (txpool.go:379); here the chain
+calls reset() on head events directly (the VM adapter wires the feed), and
+all operations take the pool lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .. import params
+from .state_transition import intrinsic_gas
+from .types import Signer, Transaction
+
+TX_SLOT_SIZE = 32 * 1024
+MAX_TX_SIZE = 4 * TX_SLOT_SIZE
+
+
+class TxPoolError(Exception):
+    pass
+
+
+ErrAlreadyKnown = "already known"
+ErrInvalidSender = "invalid sender"
+ErrUnderpriced = "transaction underpriced"
+ErrReplaceUnderpriced = "replacement transaction underpriced"
+ErrAccountLimitExceeded = "account holds more than allowed"
+ErrGasLimit = "exceeds block gas limit"
+ErrNegativeValue = "negative value"
+ErrOversizedData = "oversized data"
+ErrFutureTx = "future transaction"
+ErrNonceTooLow = "nonce too low"
+ErrInsufficientFunds = "insufficient funds"
+ErrIntrinsicGas = "intrinsic gas too low"
+ErrTipAboveFeeCap = "tip above fee cap"
+
+
+@dataclass
+class TxPoolConfig:
+    """txpool.go DefaultConfig."""
+
+    price_limit: int = 1
+    price_bump: int = 10          # % price bump to replace a pending tx
+    account_slots: int = 16
+    global_slots: int = 4096
+    account_queue: int = 64
+    global_queue: int = 1024
+
+
+class _TxList:
+    """Per-account nonce-sorted list (txpool list.go)."""
+
+    def __init__(self):
+        self.items: Dict[int, Transaction] = {}
+
+    def get(self, nonce: int) -> Optional[Transaction]:
+        return self.items.get(nonce)
+
+    def add(self, tx: Transaction, price_bump: int) -> Tuple[bool, Optional[Transaction]]:
+        old = self.items.get(tx.nonce)
+        if old is not None:
+            # replacement needs a price_bump% higher tip AND fee cap
+            bump = 100 + price_bump
+            if (
+                tx.gas_fee_cap * 100 < old.gas_fee_cap * bump
+                or tx.gas_tip_cap * 100 < old.gas_tip_cap * bump
+            ):
+                return False, None
+        self.items[tx.nonce] = tx
+        return True, old
+
+    def forward(self, threshold: int) -> List[Transaction]:
+        """Drop txs with nonce < threshold."""
+        dropped = [t for n, t in self.items.items() if n < threshold]
+        for t in dropped:
+            del self.items[t.nonce]
+        return dropped
+
+    def filter_cost(self, balance: int, gas_limit: int) -> List[Transaction]:
+        dropped = [
+            t for t in self.items.values()
+            if t.cost() > balance or t.gas > gas_limit
+        ]
+        for t in dropped:
+            del self.items[t.nonce]
+        return dropped
+
+    def ready(self, start: int) -> List[Transaction]:
+        """Sequential txs beginning at start."""
+        out = []
+        n = start
+        while n in self.items:
+            out.append(self.items[n])
+            n += 1
+        return out
+
+    def cap(self, limit: int) -> List[Transaction]:
+        if len(self.items) <= limit:
+            return []
+        nonces = sorted(self.items)
+        dropped = [self.items.pop(n) for n in nonces[limit:]]
+        return dropped
+
+    def __len__(self):
+        return len(self.items)
+
+    def empty(self) -> bool:
+        return not self.items
+
+
+class TxPool:
+    def __init__(self, config: TxPoolConfig, chain_config, chain):
+        self.config = config
+        self.chain_config = chain_config
+        self.chain = chain
+        self.signer = Signer(chain_config.chain_id)
+        self.mu = threading.RLock()
+
+        self.pending: Dict[bytes, _TxList] = {}
+        self.queue: Dict[bytes, _TxList] = {}
+        self.all: Dict[bytes, Transaction] = {}  # hash -> tx
+        self.pending_nonces: Dict[bytes, int] = {}
+
+        head = chain.current_block
+        self.current_head = head.header
+        self.statedb = chain.state_at(head.root)
+        self.gas_limit = head.gas_limit
+        self.min_fee: Optional[int] = None
+
+        # new-tx event subscribers (gossip wiring)
+        self._tx_feed: list = []
+
+        chain.subscribe_chain_event(lambda blk, logs: self.reset(blk.header))
+
+    # ------------------------------------------------------------ admission
+
+    def _validate(self, tx: Transaction, local: bool) -> bytes:
+        if len(tx.encode()) > MAX_TX_SIZE:
+            raise TxPoolError(ErrOversizedData)
+        if tx.value < 0:
+            raise TxPoolError(ErrNegativeValue)
+        if tx.gas > self.gas_limit:
+            raise TxPoolError(ErrGasLimit)
+        if tx.gas_fee_cap < tx.gas_tip_cap:
+            raise TxPoolError(ErrTipAboveFeeCap)
+        try:
+            sender = self.signer.sender(tx)
+        except Exception as e:
+            raise TxPoolError(ErrInvalidSender) from e
+        if not local and tx.gas_tip_cap < self.config.price_limit:
+            raise TxPoolError(ErrUnderpriced)
+        # post-AP3 minimum fee: fee cap must cover the current minimum
+        if self.min_fee is not None and tx.gas_fee_cap < self.min_fee:
+            raise TxPoolError(f"{ErrUnderpriced}: fee cap below minimum {self.min_fee}")
+        if self.statedb.get_nonce(sender) > tx.nonce:
+            raise TxPoolError(ErrNonceTooLow)
+        if self.statedb.get_balance(sender) < tx.cost():
+            raise TxPoolError(ErrInsufficientFunds)
+        rules = self.chain_config.rules(
+            self.current_head.number + 1, self.current_head.time
+        )
+        gas = intrinsic_gas(
+            tx.data, tx.access_list, tx.to is None,
+            rules.is_homestead, rules.is_istanbul, rules.is_d_upgrade,
+        )
+        if tx.gas < gas:
+            raise TxPoolError(ErrIntrinsicGas)
+        return sender
+
+    def add_remote(self, tx: Transaction) -> None:
+        self.add(tx, local=False)
+
+    def add_local(self, tx: Transaction) -> None:
+        self.add(tx, local=True)
+
+    def add(self, tx: Transaction, local: bool = False) -> None:
+        with self.mu:
+            h = tx.hash()
+            if h in self.all:
+                raise TxPoolError(ErrAlreadyKnown)
+            sender = self._validate(tx, local)
+
+            # executable now?
+            state_nonce = self.statedb.get_nonce(sender)
+            pending_nonce = self.pending_nonces.get(sender, state_nonce)
+
+            if tx.nonce <= pending_nonce:
+                plist = self.pending.setdefault(sender, _TxList())
+                inserted, old = plist.add(tx, self.config.price_bump)
+                if not inserted:
+                    raise TxPoolError(ErrReplaceUnderpriced)
+                if old is not None:
+                    self.all.pop(old.hash(), None)
+                self.all[h] = tx
+                self.pending_nonces[sender] = max(pending_nonce, tx.nonce + 1)
+                self._promote(sender)
+            else:
+                qlist = self.queue.setdefault(sender, _TxList())
+                if len(qlist) >= self.config.account_queue:
+                    raise TxPoolError(ErrAccountLimitExceeded)
+                inserted, old = qlist.add(tx, self.config.price_bump)
+                if not inserted:
+                    raise TxPoolError(ErrReplaceUnderpriced)
+                if old is not None:
+                    self.all.pop(old.hash(), None)
+                self.all[h] = tx
+            for fn in self._tx_feed:
+                fn([tx])
+
+    def _promote(self, sender: bytes) -> None:
+        """Move now-sequential queued txs into pending."""
+        qlist = self.queue.get(sender)
+        if qlist is None:
+            return
+        next_nonce = self.pending_nonces.get(
+            sender, self.statedb.get_nonce(sender)
+        )
+        for tx in qlist.ready(next_nonce):
+            plist = self.pending.setdefault(sender, _TxList())
+            plist.add(tx, self.config.price_bump)
+            del qlist.items[tx.nonce]
+            self.pending_nonces[sender] = tx.nonce + 1
+        if qlist.empty():
+            self.queue.pop(sender, None)
+
+    # -------------------------------------------------------------- queries
+
+    def get(self, tx_hash: bytes) -> Optional[Transaction]:
+        return self.all.get(tx_hash)
+
+    def has(self, tx_hash: bytes) -> bool:
+        return tx_hash in self.all
+
+    def nonce(self, addr: bytes) -> int:
+        with self.mu:
+            return self.pending_nonces.get(addr, self.statedb.get_nonce(addr))
+
+    def pending_txs(self) -> Dict[bytes, List[Transaction]]:
+        """Pending (txpool.go:599): executable txs per account, nonce order."""
+        with self.mu:
+            out = {}
+            for addr, plist in self.pending.items():
+                start = self.statedb.get_nonce(addr)
+                txs = plist.ready(start)
+                if txs:
+                    out[addr] = txs
+            return out
+
+    def stats(self) -> Tuple[int, int]:
+        with self.mu:
+            return (
+                sum(len(l) for l in self.pending.values()),
+                sum(len(l) for l in self.queue.values()),
+            )
+
+    def subscribe_new_txs(self, fn) -> None:
+        self._tx_feed.append(fn)
+
+    # ---------------------------------------------------------------- reset
+
+    def reset(self, new_head) -> None:
+        """Head changed: drop included/stale txs, revalidate balances
+        (txpool.go reset path)."""
+        with self.mu:
+            self.current_head = new_head
+            self.statedb = self.chain.state_at(new_head.root)
+            self.gas_limit = new_head.gas_limit
+            if self.chain_config.is_apricot_phase3(new_head.time):
+                from ..consensus.dummy import estimate_next_base_fee
+
+                try:
+                    _, self.min_fee = estimate_next_base_fee(
+                        self.chain_config, new_head, new_head.time
+                    )
+                except Exception:
+                    self.min_fee = None
+            for addr in list(self.pending):
+                plist = self.pending[addr]
+                state_nonce = self.statedb.get_nonce(addr)
+                for tx in plist.forward(state_nonce):
+                    self.all.pop(tx.hash(), None)
+                for tx in plist.filter_cost(
+                    self.statedb.get_balance(addr), self.gas_limit
+                ):
+                    self.all.pop(tx.hash(), None)
+                if plist.empty():
+                    del self.pending[addr]
+                    self.pending_nonces.pop(addr, None)
+                else:
+                    self.pending_nonces[addr] = max(plist.items) + 1
+            for addr in list(self.queue):
+                qlist = self.queue[addr]
+                for tx in qlist.forward(self.statedb.get_nonce(addr)):
+                    self.all.pop(tx.hash(), None)
+                if qlist.empty():
+                    del self.queue[addr]
+                else:
+                    self._promote(addr)

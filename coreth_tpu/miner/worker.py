@@ -97,7 +97,7 @@ class Worker:
         statedb = self.chain.state_at(parent.root)
 
         if pending is None:
-            pending = self.tx_pool.pending() if self.tx_pool is not None else {}
+            pending = self.tx_pool.pending_txs() if self.tx_pool is not None else {}
 
         txs: List[Transaction] = []
         receipts: list = []

@@ -1,0 +1,309 @@
+"""The snowman ChainVM (role of /root/reference/plugin/evm/vm.go).
+
+Initialize wires config → databases → genesis/fork config → chain backend
+→ mempools → atomic state (vm.go:315-549); buildBlock assembles through
+the miner + atomic mempool (:991-1032); parseBlock/getBlock/SetPreference
+serve the consensus engine (:1034-1096). Atomic txs flow through the
+ConsensusCallbacks into block bodies (vm.go:696-851).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .. import params, rlp
+from ..consensus.dummy import ConsensusCallbacks, DummyEngine
+from ..core.blockchain import BlockChain, CacheConfig
+from ..core.genesis import Genesis
+from ..core.txpool import TxPool, TxPoolConfig
+from ..core.types import Block as EthBlock
+from ..miner.worker import Worker
+from ..state.database import Database
+from ..trie.triedb import TrieDatabase
+from .atomic_tx import (
+    Tx,
+    calculate_dynamic_fee,
+    decode_tx,
+    encode_atomic_txs,
+    extract_atomic_txs,
+)
+from .block import BlockStatus, VMBlock
+from .mempool import Mempool
+from .shared_memory import Requests
+
+AVAX_ASSET_ID = keccak_placeholder = b"\x41" * 32  # test default; ctx overrides
+
+
+@dataclass
+class VMConfig:
+    """plugin/evm/config.go subset — the knobs the runtime honors now."""
+
+    pruning: bool = True
+    commit_interval: int = 4096
+    mempool_size: int = 4096
+    clock: Optional[object] = None
+
+
+@dataclass
+class SnowContext:
+    """snow.Context subset the VM needs (ids + shared memory)."""
+
+    network_id: int = 1337
+    chain_id: bytes = b"\x02" * 32          # this blockchain's avalanche ID
+    x_chain_id: bytes = b"\x58" * 32
+    avax_asset_id: bytes = AVAX_ASSET_ID
+    shared_memory: object = None
+
+
+class VMError(Exception):
+    pass
+
+
+class VM:
+    def __init__(self):
+        self.initialized = False
+
+    # --- snowman ChainVM: Initialize (vm.go:315-549) ----------------------
+
+    def initialize(
+        self,
+        ctx: SnowContext,
+        diskdb,
+        genesis: Genesis,
+        config: VMConfig = None,
+        to_engine=None,
+    ) -> None:
+        self.ctx = ctx
+        self.config = config or VMConfig()
+        self.chain_config = genesis.config
+        self.chain_id_bytes = ctx.chain_id
+        self.avax_asset_id = ctx.avax_asset_id
+        self.shared_memory = (
+            ctx.shared_memory.new_shared_memory(ctx.chain_id)
+            if hasattr(ctx.shared_memory, "new_shared_memory")
+            else ctx.shared_memory
+        )
+        self.atomic_codec = None
+        self.to_engine = to_engine  # callable: notify engine txs are ready
+
+        clock = self.config.clock or (lambda: self._now())
+
+        cb = ConsensusCallbacks(
+            on_finalize_and_assemble=self._on_finalize_and_assemble,
+            on_extra_state_change=self._on_extra_state_change,
+        )
+        self.engine = DummyEngine(cb)
+
+        self.state_database = Database(TrieDatabase(diskdb))
+        self.blockchain = BlockChain(
+            diskdb,
+            CacheConfig(
+                pruning=self.config.pruning,
+                commit_interval=self.config.commit_interval,
+            ),
+            self.chain_config,
+            genesis,
+            self.engine,
+            state_database=self.state_database,
+        )
+        self.txpool = TxPool(TxPoolConfig(), self.chain_config, self.blockchain)
+        self.miner = Worker(
+            self.chain_config, self.engine, self.blockchain,
+            tx_pool=self.txpool, clock=clock,
+        )
+
+        def price(tx: Tx) -> int:
+            gas = max(tx.gas_used(self.current_rules().is_apricot_phase5), 1)
+            return tx.burned(self.avax_asset_id) // gas
+
+        self.mempool = Mempool(self.config.mempool_size, fee_fn=price)
+
+        self._verified_blocks: Dict[bytes, VMBlock] = {}
+        self._accepted_atomic_ops: List = []
+        genesis_vmb = VMBlock(self, self.blockchain.genesis_block)
+        genesis_vmb.status = BlockStatus.ACCEPTED
+        self.last_accepted_vm_block = genesis_vmb
+        self.preferred_block: VMBlock = genesis_vmb
+        self._building_txs: List[Tx] = []
+        self.lock = threading.RLock()
+        self.initialized = True
+
+        # notify the engine when txs arrive (block_builder.go signal)
+        self.txpool.subscribe_new_txs(lambda txs: self._signal_txs_ready())
+
+    @staticmethod
+    def _now() -> int:
+        import time
+
+        return int(time.time())
+
+    def current_rules(self):
+        head = self.blockchain.current_block
+        return self.chain_config.rules(head.number + 1, head.time)
+
+    def _signal_txs_ready(self) -> None:
+        if self.to_engine is not None:
+            self.to_engine()
+
+    # --- consensus callbacks (vm.go:696-851) ------------------------------
+
+    def _on_finalize_and_assemble(self, header, state, txs):
+        """Pull atomic txs from the mempool into the block being built."""
+        rules = self.chain_config.rules(header.number, header.time)
+        batch = rules.is_apricot_phase5
+        picked: List[Tx] = []
+        contribution = 0
+        ext_gas_used = 0
+        snap = state.snapshot()
+        while True:
+            tx = self.mempool.next_tx()
+            if tx is None:
+                break
+            inner_snap = state.snapshot()
+            try:
+                tx.semantic_verify(self, header.base_fee)
+                tx.evm_state_transfer(self, state)
+            except Exception:
+                state.revert_to_snapshot(inner_snap)
+                self.mempool.remove_tx(tx)
+                continue
+            if rules.is_apricot_phase4:
+                try:
+                    contrib, gas = tx.block_fee_contribution(
+                        rules.is_apricot_phase5, self.avax_asset_id, header.base_fee
+                    )
+                    contribution += contrib
+                    ext_gas_used += gas
+                except Exception:
+                    state.revert_to_snapshot(inner_snap)
+                    self.mempool.remove_tx(tx)
+                    continue
+            if batch and ext_gas_used > params.ATOMIC_GAS_LIMIT:
+                # this tx overflows the AP5 atomic gas budget: undo its
+                # state changes, requeue it, and build with what we have
+                state.revert_to_snapshot(inner_snap)
+                if rules.is_apricot_phase4:
+                    # undo the contribution accounting added above
+                    contrib, gas = tx.block_fee_contribution(
+                        rules.is_apricot_phase5, self.avax_asset_id, header.base_fee
+                    )
+                    contribution -= contrib
+                    ext_gas_used -= gas
+                self.mempool.cancel_current_tx(tx.id())
+                break
+            picked.append(tx)
+            if not batch:
+                break
+        self._building_txs = picked
+        ext_data = encode_atomic_txs(picked, batch)
+        return ext_data, contribution, ext_gas_used
+
+    def _on_extra_state_change(self, block, state):
+        """Verify-side: apply the block's atomic txs to the state."""
+        rules = self.chain_config.rules(block.number, block.time)
+        txs = extract_atomic_txs(
+            block.ext_data, rules.is_apricot_phase5, self.atomic_codec
+        )
+        contribution = 0
+        ext_gas_used = 0
+        for tx in txs:
+            tx.evm_state_transfer(self, state)
+            if rules.is_apricot_phase4:
+                contrib, gas = tx.block_fee_contribution(
+                    rules.is_apricot_phase5, self.avax_asset_id, block.base_fee
+                )
+                contribution += contrib
+                ext_gas_used += gas
+        return contribution, ext_gas_used
+
+    # --- snowman interface -------------------------------------------------
+
+    def build_block(self) -> VMBlock:
+        """buildBlock (vm.go:991-1032)."""
+        with self.lock:
+            self._building_txs = []
+            eth_block = self.miner.commit_new_work()
+            if not eth_block.transactions and not self._building_txs:
+                raise VMError("block contains no transactions")
+            vmb = VMBlock(self, eth_block)
+            # verify without writes: re-executes like a peer would
+            vmb.syntactic_verify()
+            self.blockchain.insert_block_manual(eth_block, writes=False)
+            self.mempool.issue_current_txs()
+            return vmb
+
+    def parse_block(self, blob: bytes) -> VMBlock:
+        eth_block = EthBlock.decode(blob)
+        existing = self._verified_blocks.get(eth_block.hash())
+        if existing is not None:
+            return existing
+        return VMBlock(self, eth_block)
+
+    def get_block(self, block_id: bytes) -> Optional[VMBlock]:
+        vmb = self._verified_blocks.get(block_id)
+        if vmb is not None:
+            return vmb
+        eth_block = self.blockchain.get_block(block_id)
+        if eth_block is None:
+            return None
+        vmb = VMBlock(self, eth_block)
+        if self.blockchain.get_canonical_hash(eth_block.number) == block_id and (
+            eth_block.number <= self.last_accepted_vm_block.height()
+        ):
+            vmb.status = BlockStatus.ACCEPTED
+        return vmb
+
+    def set_preference(self, block_id: bytes) -> None:
+        """SetPreference (vm.go:1076)."""
+        vmb = self.get_block(block_id)
+        if vmb is None:
+            raise VMError("cannot set preference to unknown block")
+        self.preferred_block = vmb
+        self.blockchain.set_preference(vmb.eth_block)
+
+    def last_accepted(self) -> VMBlock:
+        return self.last_accepted_vm_block
+
+    def shutdown(self) -> None:
+        if self.initialized:
+            self.blockchain.stop()
+
+    # --- VMBlock support ---------------------------------------------------
+
+    def add_verified_block(self, vmb: VMBlock) -> None:
+        self._verified_blocks[vmb.id()] = vmb
+
+    def forget_verified_block(self, block_id: bytes) -> None:
+        self._verified_blocks.pop(block_id, None)
+
+    def set_last_accepted(self, vmb: VMBlock) -> None:
+        self.last_accepted_vm_block = vmb
+
+    def atomic_backend_apply(self, vmb: VMBlock, tx: Tx) -> None:
+        """Accept-path shared memory commit (block.go:164-168): apply the
+        tx's requests atomically with the VM db batch."""
+        chain, requests = tx.atomic_ops()
+        self.shared_memory.apply({chain: requests})
+        self.mempool.remove_tx(tx)
+
+    # --- atomic tx issuance (vm.go:1297-1417) -----------------------------
+
+    def issue_atomic_tx(self, tx: Tx) -> None:
+        tx.semantic_verify(self, self._next_base_fee())
+        self.mempool.add(tx)
+        self._signal_txs_ready()
+
+    def _next_base_fee(self) -> Optional[int]:
+        head = self.blockchain.current_block.header
+        if not self.chain_config.is_apricot_phase3(head.time):
+            return None
+        from ..consensus.dummy import estimate_next_base_fee
+
+        _, fee = estimate_next_base_fee(self.chain_config, head, head.time)
+        return fee
+
+    def issue_tx(self, tx) -> None:
+        """eth tx entry (API/gossip)."""
+        self.txpool.add_local(tx)

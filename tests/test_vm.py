@@ -1,0 +1,287 @@
+"""VM integration tests (modeled on /root/reference/plugin/evm/vm_test.go:
+GenesisVM fixtures driving the real snowman interface — issueTx →
+buildBlock → Verify → Accept — plus import/export atomic txs over an
+in-process shared memory)."""
+
+import pytest
+
+from coreth_tpu import params
+from coreth_tpu.core.genesis import Genesis, GenesisAccount
+from coreth_tpu.core.types import Signer, Transaction
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.vm.atomic_tx import (
+    EVMInput,
+    EVMOutput,
+    ExportTx,
+    ImportTx,
+    Tx,
+    UTXO,
+    X2C_RATE,
+    decode_tx,
+)
+from coreth_tpu.vm.block import BlockStatus
+from coreth_tpu.vm.mempool import Mempool
+from coreth_tpu.vm.shared_memory import Element, Memory, Requests
+from coreth_tpu.vm.vm import SnowContext, VM, VMConfig
+
+KEY = b"\x11" * 32
+ADDR = priv_to_address(KEY)
+DEST = b"\xbb" * 20
+
+X_CHAIN = b"\x58" * 32
+C_CHAIN = b"\x02" * 32
+AVAX = b"\x41" * 32
+
+FUND = 10**24
+
+
+def genesis_vm(shared_mem: Memory = None, cfg=None):
+    """GenesisVM (vm_test.go:224): boot a full VM on a memdb."""
+    chain_cfg = cfg or params.TEST_CHAIN_CONFIG
+    mem = shared_mem or Memory()
+    ctx = SnowContext(chain_id=C_CHAIN, x_chain_id=X_CHAIN,
+                      avax_asset_id=AVAX, shared_memory=mem)
+    vm = VM()
+    genesis = Genesis(
+        config=chain_cfg,
+        gas_limit=params.CORTINA_GAS_LIMIT,
+        alloc={ADDR: GenesisAccount(balance=FUND)},
+    )
+    clock = [0]
+
+    def tick():
+        clock[0] = vm.blockchain.current_block.time + 2
+        return clock[0]
+
+    vm.initialize(ctx, MemoryDB(), genesis, VMConfig(clock=tick))
+    return vm, mem
+
+
+def signed_transfer(nonce, value=1, tip=10**9):
+    t = Transaction(
+        type=2, chain_id=43112, nonce=nonce, max_fee=10**12,
+        max_priority_fee=tip, gas=21000, to=DEST, value=value,
+    )
+    return Signer(43112).sign(t, KEY)
+
+
+class TestSnowmanLifecycle:
+    def test_issue_build_verify_accept(self):
+        vm, _ = genesis_vm()
+        signals = []
+        vm.to_engine = lambda: signals.append(1)
+        vm.issue_tx(signed_transfer(0))
+        assert signals  # engine notified
+        blk = vm.build_block()
+        blk.verify()
+        assert blk.status == BlockStatus.PROCESSING
+        vm.set_preference(blk.id())
+        blk.accept()
+        vm.blockchain.drain_acceptor_queue()
+        assert blk.status == BlockStatus.ACCEPTED
+        assert vm.last_accepted().id() == blk.id()
+        assert vm.blockchain.state().get_balance(DEST) == 1
+        vm.shutdown()
+
+    def test_parse_block_round_trip(self):
+        vm, _ = genesis_vm()
+        vm.issue_tx(signed_transfer(0))
+        blk = vm.build_block()
+        parsed = vm.parse_block(blk.bytes())
+        assert parsed.id() == blk.id()
+        assert parsed.height() == blk.height()
+        vm.shutdown()
+
+    def test_empty_build_fails(self):
+        from coreth_tpu.vm.vm import VMError
+
+        vm, _ = genesis_vm()
+        with pytest.raises(VMError):
+            vm.build_block()
+        vm.shutdown()
+
+    def test_reject_and_sibling_accepts(self):
+        from coreth_tpu.core.chain_makers import generate_chain
+
+        vm, _ = genesis_vm()
+        vm.issue_tx(signed_transfer(0))
+        blk_a = vm.build_block()
+        blk_a.verify()
+        # a "remote" sibling at the same height with a different timestamp
+        sibling_blocks, _ = generate_chain(
+            vm.chain_config, vm.blockchain.genesis_block, vm.engine,
+            vm.state_database, 1, gap=30,
+            gen=lambda i, bg: bg.add_tx(signed_transfer(0, value=5)),
+        )
+        blk_b = vm.parse_block(sibling_blocks[0].encode())
+        assert blk_b.id() != blk_a.id()
+        blk_b.verify()
+        blk_b.accept()
+        blk_a.reject()
+        vm.blockchain.drain_acceptor_queue()
+        assert vm.last_accepted().id() == blk_b.id()
+        assert vm.blockchain.state().get_balance(DEST) == 5
+        vm.shutdown()
+
+
+def make_import_utxo(amount=10**9, tx_id=b"\x01" * 32, index=0):
+    return UTXO(tx_id=tx_id, output_index=index, asset_id=AVAX,
+                amount=amount, address=ADDR)
+
+
+def put_utxo_in_shared_memory(mem: Memory, utxo: UTXO):
+    """Simulate the X-chain exporting a UTXO to C-chain."""
+    x_sm = mem.new_shared_memory(X_CHAIN)
+    x_sm.apply({
+        C_CHAIN: Requests(put_requests=[
+            Element(key=utxo.utxo_id(), value=utxo.encode(), traits=[utxo.address])
+        ])
+    })
+
+
+class TestAtomicTxs:
+    def test_import_tx_lifecycle(self):
+        vm, mem = genesis_vm()
+        utxo = make_import_utxo(amount=5 * 10**9)
+        put_utxo_in_shared_memory(mem, utxo)
+
+        imp = ImportTx(
+            network_id=1337, blockchain_id=C_CHAIN, source_chain=X_CHAIN,
+            imported_inputs=[utxo],
+            outs=[EVMOutput(address=DEST, amount=4 * 10**9, asset_id=AVAX)],
+        )
+        tx = Tx(imp)
+        tx.sign([KEY])
+        vm.issue_atomic_tx(tx)
+        assert len(vm.mempool) == 1
+
+        blk = vm.build_block()
+        blk.verify()
+        blk.accept()
+        vm.blockchain.drain_acceptor_queue()
+
+        # DEST credited in wei (nAVAX * 1e9)
+        assert vm.blockchain.state().get_balance(DEST) == 4 * 10**9 * X2C_RATE
+        # UTXO consumed from shared memory
+        with pytest.raises(KeyError):
+            vm.shared_memory.get(X_CHAIN, [utxo.utxo_id()])
+        vm.shutdown()
+
+    def test_export_tx_lifecycle(self):
+        vm, mem = genesis_vm()
+        export_amt = 3 * 10**9  # nAVAX
+        exp = ExportTx(
+            network_id=1337, blockchain_id=C_CHAIN, destination_chain=X_CHAIN,
+            ins=[EVMInput(address=ADDR, amount=export_amt + 10**9, asset_id=AVAX, nonce=0)],
+            exported_outputs=[UTXO(tx_id=b"\x00" * 32, output_index=0,
+                                   asset_id=AVAX, amount=export_amt,
+                                   address=b"\x99" * 20)],
+        )
+        tx = Tx(exp)
+        tx.sign([KEY])
+        vm.issue_atomic_tx(tx)
+        blk = vm.build_block()
+        blk.verify()
+        blk.accept()
+        vm.blockchain.drain_acceptor_queue()
+
+        # balance debited in wei, nonce bumped
+        st = vm.blockchain.state()
+        assert st.get_balance(ADDR) == FUND - (export_amt + 10**9) * X2C_RATE
+        assert st.get_nonce(ADDR) == 1
+        # UTXO visible to the X chain
+        x_sm = mem.new_shared_memory(X_CHAIN)
+        out = x_sm.get(C_CHAIN, [exp.exported_outputs[0].utxo_id()])
+        assert UTXO.decode(out[0]).amount == export_amt
+        vm.shutdown()
+
+    def test_import_missing_utxo_rejected(self):
+        vm, _ = genesis_vm()
+        utxo = make_import_utxo()
+        imp = ImportTx(
+            network_id=1337, blockchain_id=C_CHAIN, source_chain=X_CHAIN,
+            imported_inputs=[utxo],
+            outs=[EVMOutput(address=DEST, amount=1, asset_id=AVAX)],
+        )
+        tx = Tx(imp)
+        tx.sign([KEY])
+        with pytest.raises(Exception):
+            vm.issue_atomic_tx(tx)
+        vm.shutdown()
+
+    def test_import_wrong_signer_rejected(self):
+        vm, mem = genesis_vm()
+        utxo = make_import_utxo()
+        put_utxo_in_shared_memory(mem, utxo)
+        imp = ImportTx(
+            network_id=1337, blockchain_id=C_CHAIN, source_chain=X_CHAIN,
+            imported_inputs=[utxo],
+            outs=[EVMOutput(address=DEST, amount=1, asset_id=AVAX)],
+        )
+        tx = Tx(imp)
+        tx.sign([b"\x99" * 32])  # not the UTXO owner
+        with pytest.raises(Exception):
+            vm.issue_atomic_tx(tx)
+        vm.shutdown()
+
+    def test_atomic_codec_round_trip(self):
+        utxo = make_import_utxo()
+        imp = ImportTx(
+            network_id=1337, blockchain_id=C_CHAIN, source_chain=X_CHAIN,
+            imported_inputs=[utxo],
+            outs=[EVMOutput(address=DEST, amount=123, asset_id=AVAX)],
+        )
+        tx = Tx(imp)
+        tx.sign([KEY])
+        decoded = decode_tx(tx.encode())
+        assert decoded.id() == tx.id()
+        assert decoded.unsigned.outs[0].amount == 123
+        assert decoded.credential_address(0) == ADDR
+
+    def test_mempool_conflict_detection(self):
+        from coreth_tpu.vm.mempool import MempoolError
+
+        utxo = make_import_utxo()
+
+        def mk(amount_out):
+            imp = ImportTx(
+                network_id=1337, blockchain_id=C_CHAIN, source_chain=X_CHAIN,
+                imported_inputs=[utxo],
+                outs=[EVMOutput(address=DEST, amount=amount_out, asset_id=AVAX)],
+            )
+            t = Tx(imp)
+            t.sign([KEY])
+            return t
+
+        pool = Mempool(fee_fn=lambda t: 10**9 - t.unsigned.outs[0].amount)
+        pool.add(mk(100))  # high price (burn = 1e9-100)
+        with pytest.raises(MempoolError):
+            pool.add(mk(200))  # lower price, conflicting UTXO
+        pool.add(mk(50), force=False)  # higher price replaces
+        assert len(pool) == 1
+
+
+class TestMixedBlocks:
+    def test_eth_and_atomic_in_one_block(self):
+        vm, mem = genesis_vm()
+        utxo = make_import_utxo(amount=5 * 10**9)
+        put_utxo_in_shared_memory(mem, utxo)
+        imp = ImportTx(
+            network_id=1337, blockchain_id=C_CHAIN, source_chain=X_CHAIN,
+            imported_inputs=[utxo],
+            outs=[EVMOutput(address=DEST, amount=4 * 10**9, asset_id=AVAX)],
+        )
+        atx = Tx(imp)
+        atx.sign([KEY])
+        vm.issue_atomic_tx(atx)
+        vm.issue_tx(signed_transfer(0, value=77))
+        blk = vm.build_block()
+        assert len(blk.eth_block.transactions) == 1
+        assert len(blk.atomic_txs) == 1
+        blk.verify()
+        blk.accept()
+        vm.blockchain.drain_acceptor_queue()
+        st = vm.blockchain.state()
+        assert st.get_balance(DEST) == 4 * 10**9 * X2C_RATE + 77
+        vm.shutdown()
