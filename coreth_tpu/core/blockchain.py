@@ -116,9 +116,6 @@ class BlockChain:
         else:
             self.trie_writer = NoPruningTrieWriter(state_database.triedb)
 
-        # snapshot tree (Phase 4): wired when snapshot_limit > 0
-        self.snaps = None
-
         # subscription feeds
         self._chain_feed: List[Callable] = []
         self._chain_accepted_feed: List[Callable] = []
@@ -145,6 +142,26 @@ class BlockChain:
                 raise ChainError("last accepted block not found")
             self.current_block = blk
             self.last_accepted = blk
+
+        # crash recovery: pruning mode persists roots only at commit
+        # intervals, so an unclean shutdown can leave the tip state missing —
+        # re-execute forward from the last committed root
+        # (loadLastState → reprocessState, blockchain.go:679,1745)
+        if not self.has_state(self.last_accepted.root):
+            self.reprocess_state(self.last_accepted, cache_config.commit_interval)
+
+        # flat snapshot tree over the last-accepted state (snapshot_limit
+        # gates it, like CacheConfig.SnapshotLimit in the reference)
+        self.snaps = None
+        if cache_config.snapshot_limit > 0:
+            from ..state.snapshot import Tree as SnapshotTree
+
+            self.snaps = SnapshotTree(
+                diskdb,
+                state_database.triedb,
+                self.last_accepted.root,
+                block_hash=self.last_accepted.hash(),
+            )
 
         # async acceptor queue (blockchain.go:563-611): decouples consensus
         # Accept from expensive post-accept work, with backpressure
@@ -316,8 +333,13 @@ class BlockChain:
         if not writes:
             return
 
-        # commit state: trie refs live until Accept/Reject balance them
-        root = statedb.commit(self.config.is_eip158(header.number))
+        # commit state: trie refs live until Accept/Reject balance them;
+        # block hashes key the snapshot diff layer (coreth CommitWithSnap)
+        root = statedb.commit(
+            self.config.is_eip158(header.number),
+            block_hash=block.hash(),
+            parent_block_hash=header.parent_hash,
+        )
         if root != header.root:
             raise ChainError("commit root mismatch")
         self.trie_writer.insert_trie(block)
@@ -355,6 +377,33 @@ class BlockChain:
         rawdb.write_canonical_hash(self.diskdb, block.hash(), block.number)
         rawdb.write_head_block_hash(self.diskdb, block.hash())
         self.current_block = block
+
+    def reprocess_state(self, target: Block, reexec_limit: int) -> None:
+        """reprocessState (blockchain.go:1745): walk back to the nearest
+        block whose root is available, then re-execute forward to [target],
+        committing each root into the trie forest."""
+        missing: List[Block] = []
+        cur = target
+        while not self.has_state(cur.root):
+            missing.append(cur)
+            if len(missing) > reexec_limit:
+                raise ChainError(
+                    f"required historical state unavailable (>{reexec_limit} blocks back)"
+                )
+            parent = self.get_block(cur.parent_hash)
+            if parent is None:
+                raise ChainError("missing ancestor during state reprocess")
+            cur = parent
+        for blk in reversed(missing):
+            parent = self.get_header(blk.parent_hash)
+            statedb = StateDB(parent.root, self.state_database)
+            receipts, _, used_gas = self.processor.process(blk, parent, statedb)
+            self.validator.validate_state(blk, statedb, receipts, used_gas)
+            root = statedb.commit(self.config.is_eip158(blk.number))
+            if root != blk.root:
+                raise ChainError("reprocessed root mismatch")
+            self.trie_writer.insert_trie(blk)
+            self.trie_writer.accept_trie(blk)
 
     # ------------------------------------------------------ accept / reject
 
@@ -456,6 +505,12 @@ class BlockChain:
             rawdb.write_canonical_hash(self.diskdb, blk.hash(), blk.number)
         self.current_block = new_head
         rawdb.write_head_block_hash(self.diskdb, new_head.hash())
+        # a reorg IS a head change: downstream (tx pool) must re-anchor on
+        # the new fork, exactly like canonical-extension inserts
+        receipts = self.get_receipts(new_head.hash()) or []
+        logs = [l for r in receipts for l in r.logs]
+        for fn in self._chain_feed:
+            fn(new_head, logs)
 
     # -------------------------------------------------------------- events
 

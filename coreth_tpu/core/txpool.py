@@ -189,8 +189,16 @@ class TxPool:
             state_nonce = self.statedb.get_nonce(sender)
             pending_nonce = self.pending_nonces.get(sender, state_nonce)
 
+            # global capacity checks (txpool.go DefaultConfig slots): a
+            # replacement never grows the pool, so only new slots count
+            total_pending = sum(len(l) for l in self.pending.values())
+            total_queued = sum(len(l) for l in self.queue.values())
             if tx.nonce <= pending_nonce:
                 plist = self.pending.setdefault(sender, _TxList())
+                is_replacement = plist.get(tx.nonce) is not None
+                if not is_replacement and total_pending >= self.config.global_slots:
+                    if not local:
+                        raise TxPoolError(ErrUnderpriced + ": pool full")
                 inserted, old = plist.add(tx, self.config.price_bump)
                 if not inserted:
                     raise TxPoolError(ErrReplaceUnderpriced)
@@ -203,6 +211,8 @@ class TxPool:
                 qlist = self.queue.setdefault(sender, _TxList())
                 if len(qlist) >= self.config.account_queue:
                     raise TxPoolError(ErrAccountLimitExceeded)
+                if qlist.get(tx.nonce) is None and total_queued >= self.config.global_queue:
+                    raise TxPoolError(ErrAccountLimitExceeded + ": queue full")
                 inserted, old = qlist.add(tx, self.config.price_bump)
                 if not inserted:
                     raise TxPoolError(ErrReplaceUnderpriced)

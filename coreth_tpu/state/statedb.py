@@ -364,7 +364,9 @@ class StateDB:
         self._objects_pending = set()
         return self.trie.hash()
 
-    def commit(self, delete_empty: bool = False) -> bytes:
+    def commit(self, delete_empty: bool = False,
+               block_hash: Optional[bytes] = None,
+               parent_block_hash: Optional[bytes] = None) -> bytes:
         """Commit to the TrieDatabase (statedb.go:1040-1160).
 
         Order: storage tries → code → account trie → TrieDB.Update.
@@ -402,6 +404,8 @@ class StateDB:
                     self._snap_destructs,
                     self._snap_accounts,
                     self._snap_storage,
+                    block_hash=block_hash,
+                    parent_block_hash=parent_block_hash,
                 )
             self._snap_destructs, self._snap_accounts, self._snap_storage = (
                 set(), {}, {},

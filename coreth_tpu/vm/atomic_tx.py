@@ -151,6 +151,12 @@ class ImportTx:
     # --- verify + state transfer (import_tx.go:181-460) -------------------
 
     def verify(self, vm) -> None:
+        if self.network_id != vm.network_id:
+            raise AtomicTxError(
+                f"wrong network id {self.network_id} != {vm.network_id}"
+            )
+        if self.blockchain_id != vm.chain_id_bytes:
+            raise AtomicTxError("wrong blockchain id")
         if self.source_chain == vm.chain_id_bytes:
             raise AtomicTxError("cannot import from self")
         if not self.imported_inputs:
@@ -233,6 +239,12 @@ class ExportTx:
         return gas
 
     def verify(self, vm) -> None:
+        if self.network_id != vm.network_id:
+            raise AtomicTxError(
+                f"wrong network id {self.network_id} != {vm.network_id}"
+            )
+        if self.blockchain_id != vm.chain_id_bytes:
+            raise AtomicTxError("wrong blockchain id")
         if self.destination_chain == vm.chain_id_bytes:
             raise AtomicTxError("cannot export to self")
         if not self.ins:

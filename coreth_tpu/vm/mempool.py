@@ -24,10 +24,13 @@ ErrAlreadyKnown = "already known"
 
 
 class Mempool:
-    def __init__(self, max_size: int = 4096, fee_fn=None):
+    def __init__(self, max_size: int = 4096, fee_fn=None, max_tx_gas=None):
         self.mu = threading.RLock()
         self.max_size = max_size
         self.fee_fn = fee_fn  # tx -> gas price (nAVAX/gas); default burned/gas
+        # per-tx gas cap (AP5 atomic gas limit): a tx that can never fit in
+        # a block must be rejected at admission or it starves the heap
+        self.max_tx_gas = max_tx_gas  # callable: tx -> bool (fits)
 
         self.tx_heap: list = []  # (-price, seq, tx_id)
         self._seq = 0
@@ -55,6 +58,8 @@ class Mempool:
                 raise MempoolError(ErrAlreadyKnown)
             if len(self.txs) >= self.max_size:
                 raise MempoolError(ErrTooManyAtomicTx)
+            if self.max_tx_gas is not None and not self.max_tx_gas(tx):
+                raise MempoolError("atomic tx exceeds atomic gas limit")
             price = self._price(tx)
             # conflict: collect ALL conflicting spenders first, compare
             # against the highest-priced one, only then evict (mempool.go —
@@ -125,8 +130,8 @@ class Mempool:
             for utxo in tx.input_utxos():
                 other = self.utxo_spenders.pop(utxo, None)
                 if other is not None and other != tx_id:
-                    conflicting = self.txs.pop(other, None)
-                    self.prices.pop(other, None)
+                    conflicting = self.txs.get(other)
+                    self._remove(other)  # clears ALL of its utxo entries
                     if conflicting is not None:
                         self._discard(other, conflicting)
 

@@ -78,6 +78,7 @@ class VM:
         self.ctx = ctx
         self.config = config or VMConfig()
         self.chain_config = genesis.config
+        self.network_id = ctx.network_id
         self.chain_id_bytes = ctx.chain_id
         self.avax_asset_id = ctx.avax_asset_id
         self.shared_memory = (
@@ -118,7 +119,15 @@ class VM:
             gas = max(tx.gas_used(self.current_rules().is_apricot_phase5), 1)
             return tx.burned(self.avax_asset_id) // gas
 
-        self.mempool = Mempool(self.config.mempool_size, fee_fn=price)
+        def fits_atomic_gas(tx: Tx) -> bool:
+            rules = self.current_rules()
+            if not rules.is_apricot_phase5:
+                return True
+            return tx.gas_used(True) <= params.ATOMIC_GAS_LIMIT
+
+        self.mempool = Mempool(
+            self.config.mempool_size, fee_fn=price, max_tx_gas=fits_atomic_gas
+        )
 
         self._verified_blocks: Dict[bytes, VMBlock] = {}
         self._accepted_atomic_ops: List = []
@@ -156,7 +165,6 @@ class VM:
         picked: List[Tx] = []
         contribution = 0
         ext_gas_used = 0
-        snap = state.snapshot()
         while True:
             tx = self.mempool.next_tx()
             if tx is None:
@@ -224,13 +232,20 @@ class VM:
         """buildBlock (vm.go:991-1032)."""
         with self.lock:
             self._building_txs = []
-            eth_block = self.miner.commit_new_work()
-            if not eth_block.transactions and not self._building_txs:
-                raise VMError("block contains no transactions")
-            vmb = VMBlock(self, eth_block)
-            # verify without writes: re-executes like a peer would
-            vmb.syntactic_verify()
-            self.blockchain.insert_block_manual(eth_block, writes=False)
+            try:
+                eth_block = self.miner.commit_new_work()
+                if not eth_block.transactions and not self._building_txs:
+                    raise VMError("block contains no transactions")
+                vmb = VMBlock(self, eth_block)
+                # verify without writes: re-executes like a peer would
+                vmb.syntactic_verify()
+                self.blockchain.insert_block_manual(eth_block, writes=False)
+            except Exception:
+                # requeue any atomic txs popped into 'issued' during the
+                # failed build (vm.go buildBlock error path CancelCurrentTxs)
+                for tx in list(self.mempool.issued.values()):
+                    self.mempool.cancel_current_tx(tx.id())
+                raise
             self.mempool.issue_current_txs()
             return vmb
 

@@ -1,0 +1,170 @@
+"""Crash recovery, pruning, and shutdown-tracker tests (modeled on the
+reference's TestReprocessAcceptBlockIdenticalStateRoot-style suites in
+core/test_blockchain.go and core/state/pruner)."""
+
+import pytest
+
+from coreth_tpu import params
+from coreth_tpu.consensus.dummy import new_dummy_engine
+from coreth_tpu.core.blockchain import BlockChain, CacheConfig, ChainError
+from coreth_tpu.core.chain_makers import generate_chain
+from coreth_tpu.core.genesis import Genesis, GenesisAccount
+from coreth_tpu.core.pruner import Pruner, ShutdownTracker
+from coreth_tpu.core.types import Signer, Transaction
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.state.database import Database
+from coreth_tpu.trie.triedb import TrieDatabase
+
+KEY = b"\x11" * 32
+ADDR = priv_to_address(KEY)
+DEST = b"\xbb" * 20
+FUND = 10**22
+
+
+def tx(nonce, value=1000):
+    t = Transaction(type=2, chain_id=43112, nonce=nonce, max_fee=10**12,
+                    max_priority_fee=10**9, gas=21000, to=DEST, value=value)
+    return Signer(43112).sign(t, KEY)
+
+
+def fresh(diskdb=None, commit_interval=4096):
+    diskdb = diskdb if diskdb is not None else MemoryDB()
+    genesis = Genesis(
+        config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+        alloc={ADDR: GenesisAccount(balance=FUND)},
+    )
+    chain = BlockChain(
+        diskdb, CacheConfig(commit_interval=commit_interval),
+        params.TEST_CHAIN_CONFIG, genesis, new_dummy_engine(),
+        state_database=Database(TrieDatabase(diskdb)),
+    )
+    return chain, diskdb, genesis
+
+
+class TestCrashRecovery:
+    def test_reprocess_state_after_restart(self):
+        """Accept blocks without hitting a commit interval, 'crash'
+        (reopen on the same disk), and verify state is re-executed."""
+        chain, diskdb, genesis = fresh(commit_interval=4096)
+        blocks, _ = generate_chain(
+            chain.config, chain.genesis_block, chain.engine,
+            chain.state_database, 5, gen=lambda i, bg: bg.add_tx(tx(i)),
+        )
+        for b in blocks:
+            chain.insert_block(b)
+            chain.accept(b)
+        chain.drain_acceptor_queue()
+        tip = chain.last_accepted
+        # simulate crash: drop the process-local trie forest (dirty nodes
+        # were never committed to disk: 5 < commit_interval)
+        chain._acceptor_queue.put(None)
+
+        reopened = BlockChain(
+            diskdb, CacheConfig(commit_interval=4096),
+            params.TEST_CHAIN_CONFIG, genesis, new_dummy_engine(),
+            state_database=Database(TrieDatabase(diskdb)),
+            last_accepted_hash=tip.hash(),
+        )
+        # state reprocessed: balances visible again
+        assert reopened.state().get_balance(DEST) == 5 * 1000
+        assert reopened.last_accepted.hash() == tip.hash()
+        reopened.stop()
+
+    def test_commit_interval_persists_state(self):
+        """With a tiny commit interval, roots land on disk and reopen
+        needs no reprocessing."""
+        chain, diskdb, genesis = fresh(commit_interval=2)
+        blocks, _ = generate_chain(
+            chain.config, chain.genesis_block, chain.engine,
+            chain.state_database, 4, gen=lambda i, bg: bg.add_tx(tx(i)),
+        )
+        for b in blocks:
+            chain.insert_block(b)
+            chain.accept(b)
+        chain.drain_acceptor_queue()
+        # block 4's root must be on disk (4 % 2 == 0 boundary)
+        assert diskdb.get(blocks[-1].root) is not None
+        chain.stop()
+
+    def test_unrecoverable_when_too_far(self):
+        chain, diskdb, genesis = fresh(commit_interval=4096)
+        blocks, _ = generate_chain(
+            chain.config, chain.genesis_block, chain.engine,
+            chain.state_database, 3, gen=lambda i, bg: bg.add_tx(tx(i)),
+        )
+        for b in blocks:
+            chain.insert_block(b)
+            chain.accept(b)
+        chain.drain_acceptor_queue()
+        tip = chain.last_accepted
+        with pytest.raises(ChainError):
+            BlockChain(
+                diskdb, CacheConfig(commit_interval=1),  # reexec limit 1
+                params.TEST_CHAIN_CONFIG, genesis, new_dummy_engine(),
+                state_database=Database(TrieDatabase(diskdb)),
+                last_accepted_hash=tip.hash(),
+            )
+
+
+class TestShutdownTracker:
+    def test_unclean_detection(self):
+        db = MemoryDB()
+        t1 = ShutdownTracker(db)
+        assert t1.mark_start() is False  # first boot: clean
+        # no done() → crash
+        t2 = ShutdownTracker(db)
+        assert t2.mark_start() is True   # unclean detected
+        t2.done()
+        t3 = ShutdownTracker(db)
+        assert t3.mark_start() is False  # clean after done()
+
+
+class TestPruner:
+    def test_prune_removes_stale_roots(self):
+        chain, diskdb, genesis = fresh(commit_interval=1)  # every block on disk
+        blocks, _ = generate_chain(
+            chain.config, chain.genesis_block, chain.engine,
+            chain.state_database, 6, gen=lambda i, bg: bg.add_tx(tx(i)),
+        )
+        for b in blocks:
+            chain.insert_block(b)
+            chain.accept(b)
+        chain.drain_acceptor_queue()
+        # every block's root is on disk
+        for b in blocks:
+            assert diskdb.get(b.root) is not None
+
+        pruner = Pruner(diskdb, chain.state_database.triedb)
+        deleted = pruner.prune(blocks[-1].root, chain.genesis_block.root)
+        assert deleted > 0
+        # tip + genesis stay readable; middle roots gone
+        assert diskdb.get(blocks[-1].root) is not None
+        assert diskdb.get(chain.genesis_block.root) is not None
+        assert diskdb.get(blocks[2].root) is None
+        # pruned-state reads still work at tip
+        from coreth_tpu.state.statedb import StateDB
+
+        st = StateDB(blocks[-1].root, Database(TrieDatabase(diskdb)))
+        assert st.get_balance(DEST) == 6 * 1000
+        chain.stop()
+
+    def test_recover_pruning_resumes(self):
+        chain, diskdb, genesis = fresh(commit_interval=1)
+        blocks, _ = generate_chain(
+            chain.config, chain.genesis_block, chain.engine,
+            chain.state_database, 3, gen=lambda i, bg: bg.add_tx(tx(i)),
+        )
+        for b in blocks:
+            chain.insert_block(b)
+            chain.accept(b)
+        chain.drain_acceptor_queue()
+        from coreth_tpu.core.pruner import PRUNING_IN_PROGRESS_KEY
+
+        # simulate an interrupted prune: marker present
+        diskdb.put(PRUNING_IN_PROGRESS_KEY, blocks[-1].root)
+        pruner = Pruner(diskdb, chain.state_database.triedb)
+        assert pruner.recover_pruning(chain.genesis_block.root) is True
+        assert diskdb.get(PRUNING_IN_PROGRESS_KEY) is None
+        assert pruner.recover_pruning() is False
+        chain.stop()
