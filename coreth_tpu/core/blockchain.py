@@ -166,6 +166,7 @@ class BlockChain:
         # async acceptor queue (blockchain.go:563-611): decouples consensus
         # Accept from expensive post-accept work, with backpressure
         self.acceptor_queue_limit = 64
+        self.acceptor_error: Optional[str] = None
         self._acceptor_queue: "queue.Queue[Optional[Block]]" = queue.Queue(
             self.acceptor_queue_limit
         )
@@ -436,6 +437,13 @@ class BlockChain:
                 return
             try:
                 self._accept_post_process(block)
+            except Exception:
+                # the acceptor thread must survive post-processing faults:
+                # a dead consumer deadlocks accept()/drain on the bounded
+                # queue; record and continue (the reference logs+continues)
+                import traceback
+
+                self.acceptor_error = traceback.format_exc()
             finally:
                 self._acceptor_queue.task_done()
                 if self._acceptor_queue.empty():

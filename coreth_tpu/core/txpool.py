@@ -125,6 +125,9 @@ class TxPool:
         self.queue: Dict[bytes, _TxList] = {}
         self.all: Dict[bytes, Transaction] = {}  # hash -> tx
         self.pending_nonces: Dict[bytes, int] = {}
+        # O(1) capacity counters, kept in sync with pending/queue sizes
+        self._pending_count = 0
+        self._queued_count = 0
 
         head = chain.current_block
         self.current_head = head.header
@@ -190,18 +193,19 @@ class TxPool:
             pending_nonce = self.pending_nonces.get(sender, state_nonce)
 
             # global capacity checks (txpool.go DefaultConfig slots): a
-            # replacement never grows the pool, so only new slots count
-            total_pending = sum(len(l) for l in self.pending.values())
-            total_queued = sum(len(l) for l in self.queue.values())
+            # replacement never grows the pool, so only new slots count;
+            # local txs bypass the caps in both partitions
             if tx.nonce <= pending_nonce:
                 plist = self.pending.setdefault(sender, _TxList())
                 is_replacement = plist.get(tx.nonce) is not None
-                if not is_replacement and total_pending >= self.config.global_slots:
-                    if not local:
-                        raise TxPoolError(ErrUnderpriced + ": pool full")
+                if (not is_replacement and not local
+                        and self._pending_count >= self.config.global_slots):
+                    raise TxPoolError(ErrUnderpriced + ": pool full")
                 inserted, old = plist.add(tx, self.config.price_bump)
                 if not inserted:
                     raise TxPoolError(ErrReplaceUnderpriced)
+                if not is_replacement:
+                    self._pending_count += 1
                 if old is not None:
                     self.all.pop(old.hash(), None)
                 self.all[h] = tx
@@ -211,11 +215,15 @@ class TxPool:
                 qlist = self.queue.setdefault(sender, _TxList())
                 if len(qlist) >= self.config.account_queue:
                     raise TxPoolError(ErrAccountLimitExceeded)
-                if qlist.get(tx.nonce) is None and total_queued >= self.config.global_queue:
+                is_replacement = qlist.get(tx.nonce) is not None
+                if (not is_replacement and not local
+                        and self._queued_count >= self.config.global_queue):
                     raise TxPoolError(ErrAccountLimitExceeded + ": queue full")
                 inserted, old = qlist.add(tx, self.config.price_bump)
                 if not inserted:
                     raise TxPoolError(ErrReplaceUnderpriced)
+                if not is_replacement:
+                    self._queued_count += 1
                 if old is not None:
                     self.all.pop(old.hash(), None)
                 self.all[h] = tx
@@ -232,8 +240,12 @@ class TxPool:
         )
         for tx in qlist.ready(next_nonce):
             plist = self.pending.setdefault(sender, _TxList())
+            was_new = plist.get(tx.nonce) is None
             plist.add(tx, self.config.price_bump)
             del qlist.items[tx.nonce]
+            self._queued_count -= 1
+            if was_new:
+                self._pending_count += 1
             self.pending_nonces[sender] = tx.nonce + 1
         if qlist.empty():
             self.queue.pop(sender, None)
@@ -309,5 +321,9 @@ class TxPool:
                     self.all.pop(tx.hash(), None)
                 if qlist.empty():
                     del self.queue[addr]
-                else:
-                    self._promote(addr)
+            # bulk filtering above bypassed the counters: resync, then
+            # promote (which keeps them incremental again)
+            self._pending_count = sum(len(l) for l in self.pending.values())
+            self._queued_count = sum(len(l) for l in self.queue.values())
+            for addr in list(self.queue):
+                self._promote(addr)

@@ -1,0 +1,157 @@
+"""App-level request/response networking (role of /root/reference/peer/
+network.go + client.go + peer_tracker.go).
+
+The reference rides AvalancheGo's AppRequest/AppResponse/AppGossip with
+request-id correlation, deadlines, and bandwidth-aware peer selection.
+Here the transport is pluggable: production would bind a socket transport;
+tests wire VMs back-to-back in-process exactly like the reference's
+syncervm tests (syncervm_test.go:269 createSyncServerAndClientVMs).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class NetworkError(Exception):
+    pass
+
+
+@dataclass
+class PeerStats:
+    """peer_tracker.go bandwidth tracking."""
+
+    requests: int = 0
+    failures: int = 0
+    total_bytes: int = 0
+    total_seconds: float = 0.0
+
+    @property
+    def bandwidth(self) -> float:
+        if self.total_seconds == 0:
+            return float("inf")  # untested peers rank first (exploration)
+        return self.total_bytes / self.total_seconds
+
+
+class PeerTracker:
+    """Bandwidth-aware peer selection (peer_tracker.go:70-198)."""
+
+    def __init__(self):
+        self.peers: Dict[bytes, PeerStats] = {}
+        self.lock = threading.Lock()
+
+    def connected(self, node_id: bytes) -> None:
+        with self.lock:
+            self.peers.setdefault(node_id, PeerStats())
+
+    def disconnected(self, node_id: bytes) -> None:
+        with self.lock:
+            self.peers.pop(node_id, None)
+
+    def track_request(self, node_id: bytes, size: int, seconds: float,
+                      ok: bool) -> None:
+        with self.lock:
+            st = self.peers.setdefault(node_id, PeerStats())
+            st.requests += 1
+            if ok:
+                st.total_bytes += size
+                st.total_seconds += max(seconds, 1e-6)
+            else:
+                st.failures += 1
+
+    def best_peer(self, exclude: Optional[set] = None) -> Optional[bytes]:
+        with self.lock:
+            candidates = [
+                (st.bandwidth, nid) for nid, st in self.peers.items()
+                if not exclude or nid not in exclude
+            ]
+        if not candidates:
+            return None
+        candidates.sort(key=lambda x: -x[0] if x[0] != float("inf") else float("-inf"))
+        # prefer untested peers, then highest bandwidth
+        untested = [nid for bw, nid in candidates if bw == float("inf")]
+        if untested:
+            return untested[0]
+        return candidates[0][1]
+
+
+class Network:
+    """SendAppRequest/Gossip surface (network.go:40,128-483). A Transport
+    delivers (node_id, request_bytes) -> response_bytes."""
+
+    def __init__(self, self_id: bytes = b"self"):
+        self.self_id = self_id
+        self.tracker = PeerTracker()
+        self._transports: Dict[bytes, Callable[[bytes, bytes], bytes]] = {}
+        self._gossip_handlers: List[Callable[[bytes, bytes], None]] = []
+        self._request_handler: Optional[Callable[[bytes, bytes], bytes]] = None
+        self._req_id = 0
+        self.lock = threading.Lock()
+
+    # --- wiring -----------------------------------------------------------
+
+    def connect(self, node_id: bytes, transport: Callable[[bytes, bytes], bytes]) -> None:
+        """Register a peer; transport(sender_id, request) -> response."""
+        self._transports[node_id] = transport
+        self.tracker.connected(node_id)
+
+    def disconnect(self, node_id: bytes) -> None:
+        self._transports.pop(node_id, None)
+        self.tracker.disconnected(node_id)
+
+    def set_request_handler(self, handler: Callable[[bytes, bytes], bytes]) -> None:
+        """Inbound AppRequest handler: (sender, bytes) -> response bytes."""
+        self._request_handler = handler
+
+    def subscribe_gossip(self, handler: Callable[[bytes, bytes], None]) -> None:
+        self._gossip_handlers.append(handler)
+
+    # --- outbound ---------------------------------------------------------
+
+    def send_request_any(self, request: bytes, deadline: float = 10.0,
+                         exclude: Optional[set] = None) -> Tuple[bytes, bytes]:
+        """SendAppRequestAny: pick the best peer; returns (node_id, response)."""
+        node_id = self.tracker.best_peer(exclude)
+        if node_id is None:
+            raise NetworkError("no peers available")
+        return node_id, self.send_request(node_id, request, deadline)
+
+    def send_request(self, node_id: bytes, request: bytes,
+                     deadline: float = 10.0) -> bytes:
+        transport = self._transports.get(node_id)
+        if transport is None:
+            raise NetworkError(f"unknown peer {node_id!r}")
+        start = time.monotonic()
+        try:
+            response = transport(self.self_id, request)
+        except Exception as e:
+            self.tracker.track_request(node_id, 0, time.monotonic() - start, False)
+            raise NetworkError(f"request to {node_id!r} failed: {e}") from e
+        elapsed = time.monotonic() - start
+        if elapsed > deadline:
+            self.tracker.track_request(node_id, 0, elapsed, False)
+            raise NetworkError("request deadline exceeded")
+        self.tracker.track_request(node_id, len(response), elapsed, True)
+        return response
+
+    def gossip(self, payload: bytes) -> None:
+        for node_id, transport in list(self._transports.items()):
+            try:
+                transport(self.self_id, b"\xff" + payload)  # gossip marker
+            except Exception:
+                pass
+
+    # --- inbound ----------------------------------------------------------
+
+    def app_request(self, sender: bytes, request: bytes) -> bytes:
+        """Entry point peers call (wire this as their transport)."""
+        if request[:1] == b"\xff":
+            for h in self._gossip_handlers:
+                h(sender, request[1:])
+            return b""
+        if self._request_handler is None:
+            raise NetworkError("no request handler registered")
+        return self._request_handler(sender, request)

@@ -15,8 +15,6 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional, Set, Tuple
 
-from ..native import keccak256
-
 # rawdb snapshot schema (core/rawdb/schema.go SnapshotAccountPrefix etc.)
 SNAPSHOT_ACCOUNT_PREFIX = b"a"
 SNAPSHOT_STORAGE_PREFIX = b"o"
@@ -119,6 +117,9 @@ class Tree:
             base = DiskLayer(diskdb, root, stored_bh or block_hash)
         elif generate:
             self._generate(root)
+            # record the generating block hash too, or a later restart
+            # would adopt a stale hash and break parent-layer lookups
+            diskdb.put(SNAPSHOT_BLOCK_HASH_KEY, block_hash)
             base = DiskLayer(diskdb, root, block_hash)
         else:
             raise SnapshotError("snapshot missing and generation disabled")

@@ -93,7 +93,12 @@ class StateDB:
         acct = None
         addr_hash = keccak256(addr)
         if self.snap is not None:
-            slim = self.snap.account(addr_hash)
+            try:
+                slim = self.snap.account(addr_hash)
+            except Exception:
+                # layer flattened under us: drop the fast path, use the trie
+                self.snap = None
+                slim = None
             if slim is not None:
                 if len(slim) == 0:
                     return None
@@ -318,7 +323,11 @@ class StateDB:
         """Flat-snapshot storage read hook used by StateObject."""
         if self.snap is None:
             return None
-        raw = self.snap.storage(addr_hash, keccak256(key))
+        try:
+            raw = self.snap.storage(addr_hash, keccak256(key))
+        except Exception:
+            self.snap = None  # flattened under us: fall back to the trie
+            return None
         if raw is None:
             return None
         if len(raw) == 0:
@@ -397,7 +406,10 @@ class StateDB:
         if root != self.original_root and merged.sets:
             self.db.triedb.update(root, self.original_root, merged)
         if self.snaps is not None and self.snap is not None:
-            if root != self.original_root:
+            # identical-root blocks still need their (empty) diff layer:
+            # Avalanche blocks are keyed by hash, and Accept will flatten
+            # this block_hash (coreth snapshot.go blockLayers semantics)
+            if root != self.original_root or block_hash is not None:
                 self.snaps.update(
                     root,
                     self.original_root,

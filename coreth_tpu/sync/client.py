@@ -1,0 +1,128 @@
+"""Sync client (role of /root/reference/sync/client/client.go).
+
+GetLeafs/GetBlocks/GetCode with response validation (range proofs checked
+via trie.verify_range_proof — client.go:180), per-attempt peer rotation,
+and bounded retries (client.go:293-361; up to 32 attempts)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..native import keccak256
+from ..peer.network import Network, NetworkError
+from ..trie.proof_range import ProofError, verify_range_proof
+from .messages import (
+    BlockRequest,
+    BlockResponse,
+    CodeRequest,
+    CodeResponse,
+    LeafsRequest,
+    LeafsResponse,
+    decode_message,
+)
+
+MAX_RETRY_ATTEMPTS = 32
+
+
+class ClientError(Exception):
+    pass
+
+
+class SyncClient:
+    def __init__(self, network: Network, max_attempts: int = MAX_RETRY_ATTEMPTS):
+        self.network = network
+        self.max_attempts = max_attempts
+
+    def _request(self, payload: bytes, validate=None):
+        """One logical request: rotate peers on ANY failure — transport
+        faults, undecodable responses, or validation rejections
+        (client.go:293-361 retry-with-rotation)."""
+        tried: set = set()
+        last_err: Optional[Exception] = None
+        for _ in range(self.max_attempts):
+            node_id = self.network.tracker.best_peer(exclude=tried)
+            if node_id is None:
+                tried = set()  # rotation exhausted: start over
+                node_id = self.network.tracker.best_peer()
+                if node_id is None:
+                    raise ClientError("no peers available")
+            try:
+                raw = self.network.send_request(node_id, payload)
+                msg = decode_message(raw)
+                if validate is not None:
+                    validate(msg)
+                return msg
+            except (NetworkError, ClientError, ProofError, ValueError) as e:
+                last_err = e
+                tried.add(node_id)
+        raise ClientError(f"exhausted retries: {last_err}")
+
+    def get_leafs(self, root: bytes, start: bytes = b"", end: bytes = b"",
+                  limit: int = 1024, account: bytes = b"") -> LeafsResponse:
+        """GetLeafs (client.go:114): fetch + verify a range-proofed batch."""
+        req = LeafsRequest(root, account, start, end, limit)
+
+        def validate(resp):
+            if not isinstance(resp, LeafsResponse):
+                raise ClientError("wrong response type")
+            self._verify_leafs(req, resp)
+
+        return self._request(req.encode(), validate)
+
+    def _verify_leafs(self, req: LeafsRequest, resp: LeafsResponse) -> None:
+        """client.go:180 region: responses must carry a valid range proof."""
+        if not resp.proof_vals:
+            # whole-trie response: only valid with no start key and no more
+            if req.start or resp.more:
+                raise ProofError("missing proof for partial response")
+            has_more = verify_range_proof(
+                req.root,
+                resp.keys[0] if resp.keys else b"",
+                resp.keys[-1] if resp.keys else b"",
+                resp.keys, resp.vals, None,
+            )
+            if has_more:
+                raise ProofError("unexpected more-elements")
+            return
+        proof_db = {keccak256(b): b for b in resp.proof_vals}
+        first = req.start if req.start else (resp.keys[0] if resp.keys else b"\x00" * 32)
+        last = resp.keys[-1] if resp.keys else first
+        has_more = verify_range_proof(
+            req.root, first, last, resp.keys, resp.vals, proof_db
+        )
+        if resp.more and not has_more:
+            raise ProofError("server claimed more leaves but proof shows none")
+
+    def get_blocks(self, block_hash: bytes, height: int, parents: int) -> List[bytes]:
+        """GetBlocks: verified parent-hash-linked block bytes, newest first."""
+        from ..core.types import Block
+
+        def validate(resp):
+            if not isinstance(resp, BlockResponse):
+                raise ClientError("wrong response type")
+            expected = block_hash
+            for blob in resp.blocks:
+                blk = Block.decode(blob)
+                if blk.hash() != expected:
+                    raise ClientError("block hash chain mismatch")
+                expected = blk.parent_hash
+
+        resp = self._request(
+            BlockRequest(block_hash, height, parents).encode(), validate
+        )
+        return list(resp.blocks)
+
+    def get_code(self, hashes: List[bytes]) -> List[bytes]:
+        """GetCode: keccak-verified code blobs."""
+
+        def validate(resp):
+            if not isinstance(resp, CodeResponse):
+                raise ClientError("wrong response type")
+            if len(resp.data) != len(hashes):
+                raise ClientError("wrong code count")
+            for h, code in zip(hashes, resp.data):
+                if keccak256(code) != h:
+                    raise ClientError(f"code hash mismatch for {h.hex()[:12]}")
+
+        resp = self._request(CodeRequest(list(hashes)).encode(), validate)
+        return list(resp.data)

@@ -1,0 +1,123 @@
+"""State-sync VM orchestration (role of /root/reference/plugin/evm/
+{syncervm_client,syncervm_server}.go).
+
+Server side: serve state summaries at commit-interval heights from
+committed roots (syncervm_server.go). Client side: accept a summary →
+fetch 256 parent blocks → sync the state trie (+ snapshot population) →
+reset the chain to the synced block (syncervm_client.go:148-330,
+blockchain.go:2051 ResetToStateSyncedBlock)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..core import rawdb
+from ..core.types import Block as EthBlock
+from ..sync.client import SyncClient
+from ..sync.messages import SyncSummary
+from ..sync.statesync import StateSyncer
+
+PARENTS_TO_FETCH = 256  # syncervm_client.go:237 parentsToGet
+SYNCABLE_INTERVAL = 16384  # state sync summary cadence (sync README)
+
+# resume marker (syncervm_client.go:111-140 summary persistence)
+SYNC_SUMMARY_KEY = b"stateSyncSummary"
+
+
+class StateSyncServer:
+    """GetLastStateSummary/GetStateSummaryByHeight (syncervm_server.go)."""
+
+    def __init__(self, chain, syncable_interval: int = SYNCABLE_INTERVAL):
+        self.chain = chain
+        self.syncable_interval = syncable_interval
+
+    def get_last_state_summary(self) -> Optional[SyncSummary]:
+        h = self.chain.last_accepted.number
+        height = (h // self.syncable_interval) * self.syncable_interval
+        return self.get_state_summary(height)
+
+    def get_state_summary(self, height: int) -> Optional[SyncSummary]:
+        if height % self.syncable_interval != 0:
+            return None
+        blk = self.chain.get_block_by_number(height)
+        if blk is None or not self.chain.has_state(blk.root):
+            return None
+        return SyncSummary(blk.number, blk.hash(), blk.root)
+
+
+class StateSyncClient:
+    """stateSyncerClient orchestration (syncervm_client.go:148-330)."""
+
+    def __init__(self, vm, client: SyncClient):
+        self.vm = vm
+        self.client = client
+
+    def accept_summary(self, summary: SyncSummary) -> None:
+        """acceptSyncSummary (:164): persist for resume, then run the sync
+        to completion (the reference does this on a goroutine; callers may
+        wrap this in a thread)."""
+        diskdb = self.vm.blockchain.diskdb
+        diskdb.put(SYNC_SUMMARY_KEY, summary.encode())
+        self.state_sync(summary)
+        diskdb.delete(SYNC_SUMMARY_KEY)
+
+    def ongoing_summary(self) -> Optional[SyncSummary]:
+        """Resume support: a persisted summary means a sync was interrupted."""
+        blob = self.vm.blockchain.diskdb.get(SYNC_SUMMARY_KEY)
+        return SyncSummary.decode(blob) if blob else None
+
+    def state_sync(self, summary: SyncSummary) -> None:
+        self._sync_blocks(summary)
+        self._sync_state_trie(summary)
+        self._finish(summary)
+
+    def _sync_blocks(self, summary: SyncSummary) -> None:
+        """syncBlocks (:237): fetch 256 parents so the chain can verify
+        descendants without gaps."""
+        blobs = self.client.get_blocks(
+            summary.block_hash, summary.block_number, PARENTS_TO_FETCH
+        )
+        diskdb = self.vm.blockchain.diskdb
+        for blob in blobs:
+            blk = EthBlock.decode(blob)
+            h, n = blk.hash(), blk.number
+            rawdb.write_header_number(diskdb, h, n)
+            rawdb.write_header_rlp(diskdb, n, h, blk.header.encode())
+            from .. import rlp
+
+            body_items = [
+                [rlp.decode(t.encode()) if t.type == 0 else t.encode()
+                 for t in blk.transactions],
+                [u.rlp_items() for u in blk.uncles],
+                blk.version,
+                blk.ext_data if blk.ext_data is not None else b"",
+            ]
+            rawdb.write_body_rlp(diskdb, n, h, rlp.encode(body_items))
+            rawdb.write_canonical_hash(diskdb, h, n)
+
+    def _sync_state_trie(self, summary: SyncSummary) -> None:
+        syncer = StateSyncer(
+            self.client, self.vm.blockchain.diskdb, summary.block_root
+        )
+        syncer.sync()
+
+    def _finish(self, summary: SyncSummary) -> None:
+        """ResetToStateSyncedBlock (blockchain.go:2051): move chain pointers
+        to the synced block and mark it accepted."""
+        chain = self.vm.blockchain
+        blk = chain.get_block(summary.block_hash)
+        if blk is None:
+            raise RuntimeError("synced block missing after block sync")
+        if not chain.has_state(blk.root):
+            raise RuntimeError("synced state missing after trie sync")
+        rawdb.write_head_block_hash(chain.diskdb, blk.hash())
+        chain._canonical[blk.number] = blk.hash()
+        chain.current_block = blk
+        chain.last_accepted = blk
+        from .block import BlockStatus, VMBlock
+
+        vmb = VMBlock(self.vm, blk)
+        vmb.status = BlockStatus.ACCEPTED
+        self.vm.last_accepted_vm_block = vmb
+        self.vm.preferred_block = vmb

@@ -1,0 +1,275 @@
+"""State sync tests: range proofs, handlers/client over an in-process
+network, and the two-VMs-in-one-process harness (modeled on
+/root/reference/plugin/evm/syncervm_test.go:269 createSyncServerAndClientVMs
+and sync/handlers + sync/client test suites)."""
+
+import random
+
+import pytest
+
+from coreth_tpu import params
+from coreth_tpu.core.genesis import Genesis, GenesisAccount
+from coreth_tpu.core.types import Signer, Transaction
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.native import keccak256
+from coreth_tpu.peer.network import Network
+from coreth_tpu.sync.client import ClientError, SyncClient
+from coreth_tpu.sync.handlers import SyncHandler
+from coreth_tpu.sync.messages import LeafsRequest, SyncSummary
+from coreth_tpu.sync.statesync import StateSyncer
+from coreth_tpu.trie.proof import prove
+from coreth_tpu.trie.proof_range import ProofError, verify_range_proof
+from coreth_tpu.trie.trie import Trie
+from coreth_tpu.trie.triedb import TrieDatabase
+from coreth_tpu.vm.shared_memory import Memory
+from coreth_tpu.vm.syncervm import StateSyncClient, StateSyncServer
+from coreth_tpu.vm.vm import SnowContext, VM, VMConfig
+
+KEY = b"\x11" * 32
+ADDR = priv_to_address(KEY)
+DEST = b"\xbb" * 20
+FUND = 10**24
+
+
+class TestRangeProofs:
+    def _trie(self, n, seed=1):
+        rng = random.Random(seed)
+        t = Trie()
+        items = {}
+        for _ in range(n):
+            k, v = rng.randbytes(32), rng.randbytes(20)
+            items[k] = v
+            t.update(k, v)
+        return t, sorted(items.items())
+
+    def _proof(self, t, *keys):
+        db = {}
+        for k in keys:
+            for blob in prove(t, k):
+                db[keccak256(blob)] = blob
+        return db
+
+    def test_middle_range(self):
+        t, items = self._trie(80)
+        root = t.hash()
+        sub = items[20:50]
+        keys = [k for k, _ in sub]
+        vals = [v for _, v in sub]
+        more = verify_range_proof(
+            root, keys[0], keys[-1], keys, vals, self._proof(t, keys[0], keys[-1])
+        )
+        assert more is True
+
+    def test_suffix_range_no_more(self):
+        t, items = self._trie(60)
+        root = t.hash()
+        sub = items[40:]
+        keys = [k for k, _ in sub]
+        vals = [v for _, v in sub]
+        more = verify_range_proof(
+            root, keys[0], keys[-1], keys, vals, self._proof(t, keys[0], keys[-1])
+        )
+        assert more is False
+
+    def test_tampered_range_fails(self):
+        t, items = self._trie(50)
+        root = t.hash()
+        sub = items[10:30]
+        keys = [k for k, _ in sub]
+        vals = [v for _, v in sub]
+        vals[5] = b"tampered"
+        with pytest.raises(ProofError):
+            verify_range_proof(
+                root, keys[0], keys[-1], keys, vals,
+                self._proof(t, keys[0], keys[-1]),
+            )
+
+    def test_injected_key_fails(self):
+        t, items = self._trie(50)
+        root = t.hash()
+        sub = items[10:30]
+        keys = [k for k, _ in sub]
+        vals = [v for _, v in sub]
+        fake = bytearray(keys[5])
+        fake[-1] ^= 1
+        keys.insert(6, bytes(fake))
+        vals.insert(6, b"injected")
+        with pytest.raises(ProofError):
+            verify_range_proof(
+                root, keys[0], keys[-1], sorted(keys), vals,
+                self._proof(t, keys[0], keys[-1]),
+            )
+
+
+def build_server_vm(n_blocks=8, txs_per_block=5):
+    mem = Memory()
+    vm = VM()
+    genesis = Genesis(
+        config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+        alloc={ADDR: GenesisAccount(balance=FUND)},
+    )
+    clock = [0]
+
+    def tick():
+        clock[0] = vm.blockchain.current_block.time + 2
+        return clock[0]
+
+    vm.initialize(
+        SnowContext(shared_memory=mem), MemoryDB(), genesis,
+        VMConfig(clock=tick, commit_interval=4),
+    )
+    signer = Signer(43112)
+    nonce = 0
+    for _ in range(n_blocks):
+        txs = []
+        for _ in range(txs_per_block):
+            t = Transaction(
+                type=2, chain_id=43112, nonce=nonce, max_fee=10**12,
+                max_priority_fee=10**9, gas=21000, to=DEST, value=3,
+            )
+            txs.append(signer.sign(t, KEY))
+            nonce += 1
+        vm.issue_tx(txs[0])
+        for t in txs[1:]:
+            vm.issue_tx(t)
+        blk = vm.build_block()
+        blk.verify()
+        blk.accept()
+    vm.blockchain.drain_acceptor_queue()
+    return vm, mem
+
+
+def wire_network(server_vm):
+    """Back-to-back wiring: the client's transport calls the server's
+    handlers directly (syncervm_test.go:269 pattern)."""
+    handler = SyncHandler(
+        server_vm.blockchain,
+        server_vm.state_database.triedb,
+        server_vm.blockchain.diskdb,
+    )
+    net = Network(self_id=b"client")
+    net.connect(b"server", lambda sender, req: handler.handle(sender, req))
+    return net
+
+
+class TestHandlersAndClient:
+    def test_leafs_round_trip(self):
+        server, _ = build_server_vm()
+        net = wire_network(server)
+        client = SyncClient(net)
+        root = server.blockchain.last_accepted.root
+        resp = client.get_leafs(root)
+        assert len(resp.keys) >= 2  # ADDR + DEST (+coinbase)
+        assert not resp.more
+
+    def test_blocks_round_trip(self):
+        server, _ = build_server_vm()
+        net = wire_network(server)
+        client = SyncClient(net)
+        tip = server.blockchain.last_accepted
+        blobs = client.get_blocks(tip.hash(), tip.number, 5)
+        assert len(blobs) == 5
+
+    def test_code_round_trip(self):
+        server, _ = build_server_vm()
+        # store some code server-side
+        code = b"\x60\x01" * 10
+        from coreth_tpu.core import rawdb
+
+        rawdb.write_code(server.blockchain.diskdb, keccak256(code), code)
+        net = wire_network(server)
+        client = SyncClient(net)
+        out = client.get_code([keccak256(code)])
+        assert out == [code]
+
+    def test_bad_code_detected(self):
+        server, _ = build_server_vm()
+        net = wire_network(server)
+        client = SyncClient(net)
+        with pytest.raises(ClientError):
+            client.get_code([b"\x12" * 32])  # server has nothing → b"" mismatch
+
+    def test_paged_leafs_with_proofs(self):
+        server, _ = build_server_vm()
+        net = wire_network(server)
+        client = SyncClient(net)
+        root = server.blockchain.last_accepted.root
+        # tiny limit forces paging + range proofs
+        resp1 = client.get_leafs(root, limit=1)
+        assert resp1.more and len(resp1.keys) == 1
+        from coreth_tpu.sync.statesync import _next_key
+
+        resp2 = client.get_leafs(root, start=_next_key(resp1.keys[0]), limit=1024)
+        assert set(resp1.keys).isdisjoint(resp2.keys)
+
+
+class TestTwoVMStateSync:
+    def test_full_state_sync(self):
+        """Two real VMs in one process: the syncer bootstraps the server's
+        committed state without executing its blocks."""
+        server, mem = build_server_vm(n_blocks=8)
+        # summary at a commit-interval height with committed state
+        sync_server = StateSyncServer(server.blockchain, syncable_interval=4)
+        summary = sync_server.get_last_state_summary()
+        assert summary is not None and summary.block_number == 8
+
+        # fresh client VM on an empty database, same genesis
+        client_vm = VM()
+        genesis = Genesis(
+            config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+            alloc={ADDR: GenesisAccount(balance=FUND)},
+        )
+        client_vm.initialize(
+            SnowContext(shared_memory=Memory()), MemoryDB(), genesis,
+            VMConfig(),
+        )
+        net = wire_network(server)
+        sync_client = StateSyncClient(client_vm, SyncClient(net))
+        sync_client.accept_summary(summary)
+
+        # the client's chain now sits at the synced block with full state
+        assert client_vm.blockchain.last_accepted.hash() == summary.block_hash
+        st = client_vm.blockchain.state()
+        assert st.get_balance(DEST) == 8 * 5 * 3
+        assert st.get_nonce(ADDR) == 40
+        # resume marker cleared after completion
+        assert sync_client.ongoing_summary() is None
+        client_vm.shutdown()
+        server.shutdown()
+
+    def test_sync_then_continue_chain(self):
+        """After state sync the client verifies + accepts new blocks built
+        by the server (the real post-sync handoff)."""
+        server, _ = build_server_vm(n_blocks=4)
+        sync_server = StateSyncServer(server.blockchain, syncable_interval=4)
+        summary = sync_server.get_last_state_summary()
+
+        client_vm = VM()
+        genesis = Genesis(
+            config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+            alloc={ADDR: GenesisAccount(balance=FUND)},
+        )
+        client_vm.initialize(
+            SnowContext(shared_memory=Memory()), MemoryDB(), genesis, VMConfig(),
+        )
+        net = wire_network(server)
+        StateSyncClient(client_vm, SyncClient(net)).accept_summary(summary)
+
+        # server builds one more block; client ingests it via parse/verify
+        signer = Signer(43112)
+        t = Transaction(type=2, chain_id=43112, nonce=20, max_fee=10**12,
+                        max_priority_fee=10**9, gas=21000, to=DEST, value=9)
+        server.issue_tx(signer.sign(t, KEY))
+        blk = server.build_block()
+        blk.verify()
+        blk.accept()
+        server.blockchain.drain_acceptor_queue()
+
+        parsed = client_vm.parse_block(blk.bytes())
+        parsed.verify()
+        parsed.accept()
+        client_vm.blockchain.drain_acceptor_queue()
+        assert client_vm.blockchain.state().get_balance(DEST) == 4 * 5 * 3 + 9
+        client_vm.shutdown()
+        server.shutdown()
