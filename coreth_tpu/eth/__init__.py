@@ -1,0 +1,2 @@
+"""Eth node backend + APIs (role of /root/reference/eth/ and
+/root/reference/internal/ethapi)."""

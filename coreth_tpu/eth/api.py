@@ -1,0 +1,247 @@
+"""The eth_* JSON-RPC namespace (role of /root/reference/internal/ethapi/
+api.go — BlockChainAPI/TransactionAPI — plus coreth's accepted-head
+semantics and GetAssetBalance, api.go:643).
+
+All quantities are 0x-hex per the JSON-RPC spec; block tags accept
+"latest"/"accepted"/"pending"/"earliest" or hex numbers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .. import params, vmerrs
+from ..core.state_transition import GasPool, Message, apply_message
+from ..core.types import Block, Receipt, Signer, Transaction
+from ..evm.evm import EVM, Config, TxContext
+from ..rpc.server import RPCError
+
+
+def hx(v: int) -> str:
+    return hex(v)
+
+
+def hb(b: bytes) -> str:
+    return "0x" + b.hex()
+
+
+def parse_hex(v: str) -> int:
+    return int(v, 16)
+
+
+def parse_bytes(v: str) -> bytes:
+    if v.startswith("0x"):
+        v = v[2:]
+    return bytes.fromhex(v)
+
+
+def parse_addr(v: str) -> bytes:
+    b = parse_bytes(v)
+    if len(b) != 20:
+        raise RPCError(-32602, f"invalid address length {len(b)}")
+    return b
+
+
+class EthAPI:
+    """eth namespace. [backend] is the EthBackend facade."""
+
+    def __init__(self, backend):
+        self.b = backend
+
+    # --- chain meta -------------------------------------------------------
+
+    def chainId(self) -> str:
+        return hx(self.b.chain_config.chain_id)
+
+    def blockNumber(self) -> str:
+        # coreth: the accepted (finalized) tip is the API head
+        return hx(self.b.last_accepted_block().number)
+
+    def syncing(self):
+        return False
+
+    def gasPrice(self) -> str:
+        return hx(self.b.suggest_gas_price())
+
+    def maxPriorityFeePerGas(self) -> str:
+        return hx(self.b.suggest_gas_tip_cap())
+
+    def feeHistory(self, block_count, newest_block="latest", reward_percentiles=None):
+        count = block_count if isinstance(block_count, int) else parse_hex(block_count)
+        return self.b.fee_history(count, newest_block, reward_percentiles or [])
+
+    # --- state reads ------------------------------------------------------
+
+    def getBalance(self, address: str, block: str = "latest") -> str:
+        state = self.b.state_at_tag(block)
+        return hx(state.get_balance(parse_addr(address)))
+
+    def getAssetBalance(self, address: str, block: str, asset_id: str) -> str:
+        """coreth-only (api.go:643): multicoin balance."""
+        state = self.b.state_at_tag(block)
+        return hx(
+            state.get_balance_multicoin(parse_addr(address), parse_bytes(asset_id))
+        )
+
+    def getTransactionCount(self, address: str, block: str = "latest") -> str:
+        if block == "pending":
+            return hx(self.b.txpool.nonce(parse_addr(address)))
+        state = self.b.state_at_tag(block)
+        return hx(state.get_nonce(parse_addr(address)))
+
+    def getCode(self, address: str, block: str = "latest") -> str:
+        state = self.b.state_at_tag(block)
+        return hb(state.get_code(parse_addr(address)))
+
+    def getStorageAt(self, address: str, slot: str, block: str = "latest") -> str:
+        state = self.b.state_at_tag(block)
+        key = parse_hex(slot).to_bytes(32, "big")
+        return hb(state.get_state(parse_addr(address), key))
+
+    # --- blocks -----------------------------------------------------------
+
+    def getBlockByNumber(self, block: str, full_txs: bool = False):
+        blk = self.b.block_by_tag(block)
+        return None if blk is None else self._marshal_block(blk, full_txs)
+
+    def getBlockByHash(self, block_hash: str, full_txs: bool = False):
+        blk = self.b.chain.get_block(parse_bytes(block_hash))
+        return None if blk is None else self._marshal_block(blk, full_txs)
+
+    def getBlockTransactionCountByNumber(self, block: str):
+        blk = self.b.block_by_tag(block)
+        return None if blk is None else hx(len(blk.transactions))
+
+    def _marshal_block(self, blk: Block, full_txs: bool) -> dict:
+        h = blk.header
+        out = {
+            "number": hx(h.number),
+            "hash": hb(blk.hash()),
+            "parentHash": hb(h.parent_hash),
+            "nonce": hb(h.nonce),
+            "sha3Uncles": hb(h.uncle_hash),
+            "logsBloom": hb(h.bloom),
+            "transactionsRoot": hb(h.tx_hash),
+            "stateRoot": hb(h.root),
+            "receiptsRoot": hb(h.receipt_hash),
+            "miner": hb(h.coinbase),
+            "difficulty": hx(h.difficulty),
+            "extraData": hb(h.extra),
+            "size": hx(len(blk.encode())),
+            "gasLimit": hx(h.gas_limit),
+            "gasUsed": hx(h.gas_used),
+            "timestamp": hx(h.time),
+            "mixHash": hb(h.mix_digest),
+            "extDataHash": hb(h.ext_data_hash),
+            "uncles": [],
+        }
+        if h.base_fee is not None:
+            out["baseFeePerGas"] = hx(h.base_fee)
+        if h.ext_data_gas_used is not None:
+            out["extDataGasUsed"] = hx(h.ext_data_gas_used)
+        if h.block_gas_cost is not None:
+            out["blockGasCost"] = hx(h.block_gas_cost)
+        if full_txs:
+            out["transactions"] = [
+                self._marshal_tx(t, blk, i) for i, t in enumerate(blk.transactions)
+            ]
+        else:
+            out["transactions"] = [hb(t.hash()) for t in blk.transactions]
+        return out
+
+    # --- transactions -----------------------------------------------------
+
+    def sendRawTransaction(self, raw: str) -> str:
+        tx = Transaction.decode(parse_bytes(raw))
+        self.b.send_tx(tx)
+        return hb(tx.hash())
+
+    def getTransactionByHash(self, tx_hash: str):
+        found = self.b.tx_by_hash(parse_bytes(tx_hash))
+        if found is None:
+            return None
+        tx, blk, index = found
+        return self._marshal_tx(tx, blk, index)
+
+    def getTransactionReceipt(self, tx_hash: str):
+        found = self.b.tx_by_hash(parse_bytes(tx_hash))
+        if found is None or found[1] is None:
+            return None
+        tx, blk, index = found
+        receipts = self.b.chain.get_receipts(blk.hash()) or []
+        if index >= len(receipts):
+            return None
+        r = receipts[index]
+        sender = Signer(self.b.chain_config.chain_id).sender(tx)
+        out = {
+            "transactionHash": hb(tx.hash()),
+            "transactionIndex": hx(index),
+            "blockHash": hb(blk.hash()),
+            "blockNumber": hx(blk.number),
+            "from": hb(sender),
+            "to": hb(tx.to) if tx.to else None,
+            "cumulativeGasUsed": hx(r.cumulative_gas_used),
+            "gasUsed": hx(r.gas_used),
+            "effectiveGasPrice": hx(tx.effective_gas_price(blk.base_fee)),
+            "contractAddress": hb(r.contract_address) if r.contract_address else None,
+            "logs": [self._marshal_log(l, i) for i, l in enumerate(r.logs)],
+            "logsBloom": hb(r.bloom),
+            "status": hx(r.status),
+            "type": hx(tx.type),
+        }
+        return out
+
+    def _marshal_tx(self, tx: Transaction, blk: Optional[Block], index: int) -> dict:
+        sender = Signer(self.b.chain_config.chain_id).sender(tx)
+        out = {
+            "hash": hb(tx.hash()),
+            "nonce": hx(tx.nonce),
+            "from": hb(sender),
+            "to": hb(tx.to) if tx.to else None,
+            "value": hx(tx.value),
+            "gas": hx(tx.gas),
+            "gasPrice": hx(tx.effective_gas_price(blk.base_fee if blk else None)),
+            "input": hb(tx.data),
+            "type": hx(tx.type),
+            "v": hx(tx.v),
+            "r": hx(tx.r),
+            "s": hx(tx.s),
+        }
+        if tx.type == 2:
+            out["maxFeePerGas"] = hx(tx.max_fee)
+            out["maxPriorityFeePerGas"] = hx(tx.max_priority_fee)
+        if blk is not None:
+            out["blockHash"] = hb(blk.hash())
+            out["blockNumber"] = hx(blk.number)
+            out["transactionIndex"] = hx(index)
+        return out
+
+    def _marshal_log(self, l, i: int) -> dict:
+        return {
+            "address": hb(l.address),
+            "topics": [hb(t) for t in l.topics],
+            "data": hb(l.data),
+            "blockNumber": hx(l.block_number),
+            "transactionHash": hb(l.tx_hash),
+            "transactionIndex": hx(l.tx_index),
+            "blockHash": hb(l.block_hash),
+            "logIndex": hx(getattr(l, "index", i)),
+            "removed": False,
+        }
+
+    # --- execution --------------------------------------------------------
+
+    def call(self, call_obj: dict, block: str = "latest") -> str:
+        result = self.b.do_call(call_obj, block)
+        if result.err is not None:
+            if vmerrs.is_revert(result.err):
+                raise RPCError(3, "execution reverted", hb(result.return_data))
+            raise RPCError(-32000, f"execution failed: {result.err}")
+        return hb(result.return_data)
+
+    def estimateGas(self, call_obj: dict, block: str = "latest") -> str:
+        return hx(self.b.estimate_gas(call_obj, block))
+
+    def getLogs(self, filter_obj: dict) -> list:
+        logs = self.b.filters.get_logs(filter_obj)
+        return [self._marshal_log(l, i) for i, l in enumerate(logs)]

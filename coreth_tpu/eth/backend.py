@@ -1,0 +1,148 @@
+"""EthBackend: the facade wiring chain + txpool + miner for the APIs
+(role of /root/reference/eth/backend.go Ethereum + eth/api_backend.go
+EthAPIBackend).
+
+Coreth semantics: "latest" == last *accepted* block unless the node opts
+into allow-unfinalized queries (api_backend.go).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from .. import params, vmerrs
+from ..core.state_transition import GasPool, Message, apply_message
+from ..core.types import Block, Transaction
+from ..evm.evm import EVM, Config, TxContext
+from ..rpc.server import RPCError
+from .api import parse_addr, parse_bytes, parse_hex
+from .filters import FilterSystem
+from .gasprice import Oracle
+
+
+class EthBackend:
+    def __init__(self, chain, txpool, allow_unfinalized_queries: bool = False):
+        self.chain = chain
+        self.txpool = txpool
+        self.chain_config = chain.config
+        self.allow_unfinalized_queries = allow_unfinalized_queries
+        self.filters = FilterSystem(self)
+        self.gpo = Oracle(self)
+
+    # --- heads ------------------------------------------------------------
+
+    def last_accepted_block(self) -> Block:
+        return self.chain.last_accepted_block()
+
+    def current_block(self) -> Block:
+        return self.chain.current_block
+
+    def block_by_tag(self, tag: str) -> Optional[Block]:
+        if tag in ("latest", "accepted"):
+            return self.last_accepted_block()
+        if tag == "pending":
+            # coreth has no pending block concept at the API: preference tip
+            return self.current_block()
+        if tag == "earliest":
+            return self.chain.genesis_block
+        number = parse_hex(tag)
+        head = self.last_accepted_block().number
+        if number > head and not self.allow_unfinalized_queries:
+            raise RPCError(
+                -32000,
+                f"cannot query unfinalized data (requested {number} > accepted {head})",
+            )
+        return self.chain.get_block_by_number(number)
+
+    def state_at_tag(self, tag: str):
+        blk = self.block_by_tag(tag)
+        if blk is None:
+            raise RPCError(-32000, "block not found")
+        return self.chain.state_at(blk.root)
+
+    # --- txs --------------------------------------------------------------
+
+    def send_tx(self, tx: Transaction) -> None:
+        self.txpool.add_local(tx)
+
+    def tx_by_hash(self, tx_hash: bytes) -> Optional[Tuple[Transaction, Optional[Block], int]]:
+        from ..core import rawdb
+
+        number = rawdb.read_tx_lookup(self.chain.diskdb, tx_hash)
+        if number is not None:
+            blk = self.chain.get_block_by_number(number)
+            if blk is not None:
+                for i, tx in enumerate(blk.transactions):
+                    if tx.hash() == tx_hash:
+                        return tx, blk, i
+        pending = self.txpool.get(tx_hash)
+        if pending is not None:
+            return pending, None, 0
+        return None
+
+    # --- fees -------------------------------------------------------------
+
+    def suggest_gas_price(self) -> int:
+        return self.gpo.suggest_price()
+
+    def suggest_gas_tip_cap(self) -> int:
+        return self.gpo.suggest_tip_cap()
+
+    def fee_history(self, count, newest_tag, percentiles):
+        return self.gpo.fee_history(count, newest_tag, percentiles)
+
+    # --- call / estimate --------------------------------------------------
+
+    def _call_msg(self, call_obj: dict, gas_default: int) -> Message:
+        from_ = parse_addr(call_obj["from"]) if call_obj.get("from") else b"\x00" * 20
+        to = parse_addr(call_obj["to"]) if call_obj.get("to") else None
+        gas = parse_hex(call_obj["gas"]) if call_obj.get("gas") else gas_default
+        gas_price = parse_hex(call_obj["gasPrice"]) if call_obj.get("gasPrice") else 0
+        value = parse_hex(call_obj["value"]) if call_obj.get("value") else 0
+        data = parse_bytes(call_obj.get("data") or call_obj.get("input") or "0x")
+        return Message(
+            from_=from_, to=to, gas_limit=gas, gas_price=gas_price,
+            value=value, data=data, skip_account_checks=True,
+        )
+
+    def do_call(self, call_obj: dict, tag: str):
+        blk = self.block_by_tag(tag)
+        if blk is None:
+            raise RPCError(-32000, "block not found")
+        state = self.chain.state_at(blk.root)
+        msg = self._call_msg(call_obj, blk.gas_limit)
+        from ..core.state_processor import new_block_context
+
+        evm = EVM(
+            new_block_context(blk.header, self.chain),
+            TxContext(origin=msg.from_, gas_price=msg.gas_price),
+            state, self.chain_config, Config(no_base_fee=True),
+        )
+        return apply_message(evm, msg, GasPool(2**63))
+
+    def estimate_gas(self, call_obj: dict, tag: str) -> int:
+        """Binary search over gas (internal/ethapi estimateGas)."""
+        blk = self.block_by_tag(tag)
+        if blk is None:
+            raise RPCError(-32000, "block not found")
+        hi = parse_hex(call_obj["gas"]) if call_obj.get("gas") else blk.gas_limit
+        lo = params.TX_GAS - 1
+
+        def executable(gas: int) -> bool:
+            obj = dict(call_obj)
+            obj["gas"] = hex(gas)
+            try:
+                res = self.do_call(obj, tag)
+            except RPCError:
+                return False
+            return res.err is None
+
+        if not executable(hi):
+            raise RPCError(-32000, "gas required exceeds allowance or always failing tx")
+        while lo + 1 < hi:
+            mid = (lo + hi) // 2
+            if executable(mid):
+                hi = mid
+            else:
+                lo = mid
+        return hi

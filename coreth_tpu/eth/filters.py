@@ -1,0 +1,167 @@
+"""Log filters & subscriptions (role of /root/reference/eth/filters/ —
+filter_system.go, filter.go; bloom-gated log search, polling filters, and
+coreth's accepted-event feeds)."""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from ..core.types import bloom_lookup
+
+FILTER_TIMEOUT = 300.0  # 5 min deactivation like filter_system.go
+
+
+def _match_topics(log, topics: List) -> bool:
+    """Topic filter semantics: position-wise, None = wildcard, list = OR."""
+    if not topics:
+        return True
+    if len(topics) > len(log.topics):
+        return False
+    for want, have in zip(topics, log.topics):
+        if want is None:
+            continue
+        options = want if isinstance(want, list) else [want]
+        if not any(o == have for o in options):
+            return False
+    return True
+
+
+def _match_address(log, addresses: List[bytes]) -> bool:
+    return not addresses or log.address in addresses
+
+
+class _Filter:
+    def __init__(self, typ: str, crit: Optional[dict] = None):
+        self.typ = typ  # "logs" | "blocks" | "pendingTxs"
+        self.crit = crit or {}
+        self.items: list = []
+        self.last_poll = time.monotonic()
+
+
+class FilterSystem:
+    """Installable polling filters + direct getLogs (filters.FilterSystem)."""
+
+    def __init__(self, backend):
+        self.b = backend
+        self.lock = threading.Lock()
+        self.filters: Dict[str, _Filter] = {}
+        # accepted-chain events drive filters (coreth semantics)
+        backend.chain.subscribe_chain_accepted_event(self._on_accepted)
+        if getattr(backend, "txpool", None) is not None:
+            backend.txpool.subscribe_new_txs(self._on_new_txs)
+
+    # --- event fan-in -----------------------------------------------------
+
+    def _on_accepted(self, block, logs) -> None:
+        with self.lock:
+            for f in self.filters.values():
+                if f.typ == "blocks":
+                    f.items.append(block.hash())
+                elif f.typ == "logs":
+                    f.items.extend(self._filter_logs(logs, f.crit))
+
+    def _on_new_txs(self, txs) -> None:
+        with self.lock:
+            for f in self.filters.values():
+                if f.typ == "pendingTxs":
+                    f.items.extend(t.hash() for t in txs)
+
+    # --- filter management ------------------------------------------------
+
+    def _install(self, f: _Filter) -> str:
+        fid = "0x" + uuid.uuid4().hex
+        with self.lock:
+            self._expire_stale()
+            self.filters[fid] = f
+        return fid
+
+    def _expire_stale(self) -> None:
+        now = time.monotonic()
+        for fid in [fid for fid, f in self.filters.items()
+                    if now - f.last_poll > FILTER_TIMEOUT]:
+            del self.filters[fid]
+
+    def new_log_filter(self, crit: dict) -> str:
+        return self._install(_Filter("logs", self._parse_criteria(crit)))
+
+    def new_block_filter(self) -> str:
+        return self._install(_Filter("blocks"))
+
+    def new_pending_tx_filter(self) -> str:
+        return self._install(_Filter("pendingTxs"))
+
+    def uninstall(self, fid: str) -> bool:
+        with self.lock:
+            return self.filters.pop(fid, None) is not None
+
+    def get_changes(self, fid: str) -> list:
+        with self.lock:
+            f = self.filters.get(fid)
+            if f is None:
+                raise ValueError("filter not found")
+            f.last_poll = time.monotonic()
+            items, f.items = f.items, []
+            return items
+
+    # --- log search -------------------------------------------------------
+
+    def _parse_criteria(self, crit: dict) -> dict:
+        from .api import parse_bytes, parse_hex
+
+        out = {"addresses": [], "topics": [], "from": None, "to": None,
+               "block_hash": None}
+        addrs = crit.get("address")
+        if addrs:
+            if isinstance(addrs, str):
+                addrs = [addrs]
+            out["addresses"] = [parse_bytes(a) for a in addrs]
+        for t in crit.get("topics", []):
+            if t is None:
+                out["topics"].append(None)
+            elif isinstance(t, list):
+                out["topics"].append([parse_bytes(x) for x in t])
+            else:
+                out["topics"].append(parse_bytes(t))
+        if crit.get("blockHash"):
+            out["block_hash"] = parse_bytes(crit["blockHash"])
+        else:
+            if crit.get("fromBlock") not in (None, "latest", "pending"):
+                out["from"] = parse_hex(crit["fromBlock"])
+            if crit.get("toBlock") not in (None, "latest", "pending"):
+                out["to"] = parse_hex(crit["toBlock"])
+        return out
+
+    def _filter_logs(self, logs, crit: dict) -> list:
+        return [
+            l for l in logs
+            if _match_address(l, crit["addresses"]) and _match_topics(l, crit["topics"])
+        ]
+
+    def get_logs(self, raw_crit: dict) -> list:
+        """eth_getLogs: walk the accepted range, bloom-gated per block."""
+        crit = self._parse_criteria(raw_crit)
+        chain = self.b.chain
+        head = self.b.last_accepted_block().number
+        if crit["block_hash"] is not None:
+            blocks = [chain.get_block(crit["block_hash"])]
+        else:
+            lo = crit["from"] if crit["from"] is not None else head
+            hi = crit["to"] if crit["to"] is not None else head
+            hi = min(hi, head)
+            blocks = [chain.get_block_by_number(n) for n in range(lo, hi + 1)]
+        out = []
+        for blk in blocks:
+            if blk is None:
+                continue
+            # bloom pre-filter: skip blocks that cannot contain a match
+            if crit["addresses"] and not any(
+                bloom_lookup(blk.header.bloom, a) for a in crit["addresses"]
+            ):
+                continue
+            receipts = chain.get_receipts(blk.hash()) or []
+            for r in receipts:
+                out.extend(self._filter_logs(r.logs, crit))
+        return out

@@ -1,0 +1,109 @@
+"""Gas price oracle (role of /root/reference/eth/gasprice/{gasprice,
+feehistory}.go + coreth's fee_info_provider.go accepted-header cache).
+
+Suggests tips from the percentile of effective tips over recent accepted
+blocks; feeHistory reports base fees / gas ratios / reward percentiles.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .. import params
+from ..consensus.dummy import estimate_next_base_fee
+
+CHECK_BLOCKS = 20
+PERCENTILE = 60
+MAX_LOOKBACK = 2048
+
+
+class Oracle:
+    def __init__(self, backend, check_blocks: int = CHECK_BLOCKS,
+                 percentile: int = PERCENTILE):
+        self.b = backend
+        self.check_blocks = check_blocks
+        self.percentile = percentile
+
+    def _recent_tips(self) -> List[int]:
+        chain = self.b.chain
+        head = self.b.last_accepted_block()
+        tips: List[int] = []
+        blk = head
+        for _ in range(self.check_blocks):
+            if blk is None or blk.number == 0:
+                break
+            base_fee = blk.base_fee
+            for tx in blk.transactions:
+                tip = tx.effective_gas_tip(base_fee)
+                if tip >= 0:
+                    tips.append(tip)
+            blk = chain.get_block(blk.parent_hash)
+        return sorted(tips)
+
+    def suggest_tip_cap(self) -> int:
+        tips = self._recent_tips()
+        if not tips:
+            return 0
+        return tips[min(len(tips) - 1, len(tips) * self.percentile // 100)]
+
+    def suggest_price(self) -> int:
+        """Tip + the estimated next base fee (post-AP3)."""
+        head = self.b.last_accepted_block().header
+        tip = self.suggest_tip_cap()
+        if self.b.chain_config.is_apricot_phase3(head.time):
+            try:
+                _, next_base = estimate_next_base_fee(
+                    self.b.chain_config, head, head.time
+                )
+            except Exception:
+                next_base = head.base_fee or 0
+            return tip + next_base
+        return max(tip, params.LAUNCH_MIN_GAS_PRICE)
+
+    def fee_history(self, count: int, newest_tag: str, percentiles: List[float]) -> dict:
+        count = min(count, MAX_LOOKBACK)
+        newest = self.b.block_by_tag(newest_tag)
+        if newest is None or count == 0:
+            return {"oldestBlock": "0x0", "baseFeePerGas": [], "gasUsedRatio": []}
+        chain = self.b.chain
+        blocks = []
+        blk = newest
+        for _ in range(count):
+            if blk is None:
+                break
+            blocks.append(blk)
+            if blk.number == 0:
+                break
+            blk = chain.get_block(blk.parent_hash)
+        blocks.reverse()
+        base_fees = [b.base_fee or 0 for b in blocks]
+        # next base fee after the newest block
+        try:
+            _, nxt = estimate_next_base_fee(
+                self.b.chain_config, newest.header, newest.time
+            )
+            base_fees.append(nxt)
+        except Exception:
+            base_fees.append(base_fees[-1] if base_fees else 0)
+        out = {
+            "oldestBlock": hex(blocks[0].number) if blocks else "0x0",
+            "baseFeePerGas": [hex(f) for f in base_fees],
+            "gasUsedRatio": [
+                (b.gas_used / b.gas_limit) if b.gas_limit else 0.0 for b in blocks
+            ],
+        }
+        if percentiles:
+            rewards = []
+            for b in blocks:
+                tips = sorted(
+                    tx.effective_gas_tip(b.base_fee) for tx in b.transactions
+                )
+                if not tips:
+                    rewards.append([hex(0)] * len(percentiles))
+                    continue
+                rewards.append([
+                    hex(tips[min(len(tips) - 1, int(len(tips) * p / 100))])
+                    for p in percentiles
+                ])
+            out["reward"] = rewards
+        return out

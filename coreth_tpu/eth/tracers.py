@@ -1,0 +1,192 @@
+"""Transaction tracing (role of /root/reference/eth/tracers/ — debug_trace*
+APIs over re-executed state, the struct logger, and the native call
+tracer; eth/tracers/api.go:241-674, native/call.go, logger/logger.go).
+
+Historical state is recovered by re-executing the block's txs from the
+parent root (eth/state_accessor.go pattern).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core.state_processor import apply_transaction, new_block_context
+from ..core.state_transition import GasPool
+from ..core.types import Signer
+from ..evm.evm import EVM, Config, TxContext
+from ..rpc.server import RPCError
+from .api import hb, hx, parse_bytes
+
+
+class StructLogger:
+    """vm.Config.Tracer hook collecting per-op execution logs
+    (eth/tracers/logger/logger.go StructLog)."""
+
+    def __init__(self, with_memory: bool = False, with_stack: bool = True,
+                 with_storage: bool = False, limit: int = 0):
+        self.logs: List[dict] = []
+        self.with_memory = with_memory
+        self.with_stack = with_stack
+        self.with_storage = with_storage
+        self.limit = limit
+        self.failed = False
+        self.output = b""
+        self.gas_used = 0
+
+    def capture_state(self, pc, op, gas, cost, scope, return_data, depth) -> None:
+        if self.limit and len(self.logs) >= self.limit:
+            return
+        from ..evm import opcodes as OP
+
+        entry = {
+            "pc": pc,
+            "op": OP.name(op),
+            "gas": gas,
+            "gasCost": cost,
+            "depth": depth,
+        }
+        if self.with_stack:
+            entry["stack"] = [hex(v) for v in scope.stack.data]
+        if self.with_memory:
+            entry["memory"] = scope.memory.get(0, len(scope.memory)).hex()
+        self.logs.append(entry)
+
+    def result(self) -> dict:
+        return {
+            "gas": self.gas_used,
+            "failed": self.failed,
+            "returnValue": self.output.hex(),
+            "structLogs": self.logs,
+        }
+
+
+class CallTracer:
+    """Native call tracer (eth/tracers/native/call.go): nested call frames."""
+
+    def __init__(self):
+        self.frames: List[dict] = []
+        self.stack: List[dict] = []
+
+    def enter(self, typ: str, from_: bytes, to: Optional[bytes], value: int,
+              gas: int, input_: bytes) -> None:
+        frame = {
+            "type": typ,
+            "from": hb(from_),
+            "to": hb(to) if to else None,
+            "value": hx(value),
+            "gas": hx(gas),
+            "input": hb(input_),
+            "calls": [],
+        }
+        if self.stack:
+            self.stack[-1]["calls"].append(frame)
+        else:
+            self.frames.append(frame)
+        self.stack.append(frame)
+
+    def exit(self, output: bytes, gas_used: int, err: Optional[str]) -> None:
+        frame = self.stack.pop()
+        frame["output"] = hb(output)
+        frame["gasUsed"] = hx(gas_used)
+        if err:
+            frame["error"] = err
+
+    def capture_state(self, *a, **kw) -> None:
+        pass
+
+    def result(self) -> dict:
+        return self.frames[0] if self.frames else {}
+
+
+class DebugAPI:
+    """debug namespace: traceTransaction/traceBlockByNumber/traceCall."""
+
+    def __init__(self, backend):
+        self.b = backend
+
+    def _re_execute(self, blk, upto_index: Optional[int], tracer_factory):
+        """Re-run the block's txs from the parent state; attach a fresh
+        tracer to each traced tx. Returns list of (tx, tracer, result)."""
+        chain = self.b.chain
+        parent = chain.get_header(blk.parent_hash)
+        if parent is None:
+            raise RPCError(-32000, "parent block not found")
+        state = chain.state_at(parent.root)
+        gp = GasPool(blk.gas_limit)
+        results = []
+        for i, tx in enumerate(blk.transactions):
+            traced = upto_index is None or i == upto_index
+            tracer = tracer_factory() if traced else None
+            cfg = Config(tracer=tracer if isinstance(tracer, StructLogger) else None)
+            block_ctx = new_block_context(blk.header, chain)
+            evm = EVM(block_ctx, TxContext(), state, self.b.chain_config, cfg)
+            if isinstance(tracer, CallTracer):
+                evm = _instrument_call_tracer(evm, tracer)
+            state.set_tx_context(tx.hash(), i)
+            used = [0]
+            receipt = apply_transaction(
+                self.b.chain_config, chain, evm, gp, state, blk.header, tx, used
+            )
+            if traced:
+                if isinstance(tracer, StructLogger):
+                    tracer.gas_used = receipt.gas_used
+                    tracer.failed = receipt.status == 0
+                results.append((tx, tracer, receipt))
+            if upto_index is not None and i == upto_index:
+                break
+        return results
+
+    def traceTransaction(self, tx_hash: str, config: dict = None) -> dict:
+        config = config or {}
+        found = self.b.tx_by_hash(parse_bytes(tx_hash))
+        if found is None or found[1] is None:
+            raise RPCError(-32000, "transaction not found")
+        tx, blk, index = found
+        factory = self._tracer_factory(config)
+        results = self._re_execute(blk, index, factory)
+        if not results:
+            raise RPCError(-32000, "trace produced no result")
+        _, tracer, _ = results[-1]
+        return tracer.result()
+
+    def traceBlockByNumber(self, tag: str, config: dict = None) -> list:
+        config = config or {}
+        blk = self.b.block_by_tag(tag)
+        if blk is None:
+            raise RPCError(-32000, "block not found")
+        factory = self._tracer_factory(config)
+        results = self._re_execute(blk, None, factory)
+        return [
+            {"txHash": hb(tx.hash()), "result": tracer.result()}
+            for tx, tracer, _ in results
+        ]
+
+    def _tracer_factory(self, config: dict):
+        name = config.get("tracer")
+        if name == "callTracer":
+            return CallTracer
+        return lambda: StructLogger(
+            with_memory=config.get("enableMemory", False),
+            limit=config.get("limit", 0),
+        )
+
+
+def _instrument_call_tracer(evm: EVM, tracer: CallTracer) -> EVM:
+    """Wrap the EVM call/create surface to emit call frames."""
+    orig_call, orig_create = evm.call, evm._create
+
+    def call(caller, addr, input_, gas, value):
+        tracer.enter("CALL", caller, addr, value, gas, input_)
+        ret, left, err = orig_call(caller, addr, input_, gas, value)
+        tracer.exit(ret, gas - left, str(err) if err else None)
+        return ret, left, err
+
+    def create(caller, code, gas, value, addr):
+        tracer.enter("CREATE", caller, addr, value, gas, code)
+        ret, out_addr, left, err = orig_create(caller, code, gas, value, addr)
+        tracer.exit(ret, gas - left, str(err) if err else None)
+        return ret, out_addr, left, err
+
+    evm.call = call
+    evm._create = create
+    return evm

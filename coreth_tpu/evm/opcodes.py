@@ -110,3 +110,18 @@ STATICCALL = 0xFA
 REVERT = 0xFD
 INVALID = 0xFE
 SELFDESTRUCT = 0xFF
+
+
+_NAMES = {v: k for k, v in list(globals().items()) if isinstance(v, int) and not k.startswith("_")}
+for _i in range(32):
+    _NAMES[PUSH1 + _i] = f"PUSH{_i + 1}"
+for _i in range(16):
+    _NAMES[DUP1 + _i] = f"DUP{_i + 1}"
+    _NAMES[SWAP1 + _i] = f"SWAP{_i + 1}"
+for _i in range(5):
+    _NAMES[LOG0 + _i] = f"LOG{_i}"
+
+
+def name(op: int) -> str:
+    """Human-readable opcode name (opcodes.go opCodeToString)."""
+    return _NAMES.get(op, f"opcode {op:#x} not defined")

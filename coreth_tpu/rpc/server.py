@@ -1,0 +1,168 @@
+"""JSON-RPC 2.0 engine (role of /root/reference/rpc/{server,http,
+websocket,subscription}.go).
+
+Method registry keyed `namespace_method`, single + batch dispatch,
+standard error codes, and pub/sub subscriptions. Serves over HTTP via the
+stdlib ThreadingHTTPServer (handlers.go equivalents); tests can dispatch
+in-process through `handle_raw`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional
+
+PARSE_ERROR = -32700
+INVALID_REQUEST = -32600
+METHOD_NOT_FOUND = -32601
+INVALID_PARAMS = -32602
+INTERNAL_ERROR = -32603
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str, data=None):
+        super().__init__(message)
+        self.code = code
+        self.data = data
+
+
+class Subscription:
+    def __init__(self, sub_id: str, notify: Callable[[Any], None]):
+        self.id = sub_id
+        self.notify = notify
+        self.active = True
+
+
+class RPCServer:
+    def __init__(self):
+        self._methods: Dict[str, Callable] = {}
+        self._subscriptions: Dict[str, Subscription] = {}
+        self._sub_factories: Dict[str, Callable] = {}
+        self.lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # --- registration -----------------------------------------------------
+
+    def register(self, namespace: str, name: str, fn: Callable) -> None:
+        self._methods[f"{namespace}_{name}"] = fn
+
+    def register_api(self, namespace: str, api: object) -> None:
+        """Register every public method of [api] under [namespace]
+        (rpc/service.go reflection registration)."""
+        for attr in dir(api):
+            if attr.startswith("_"):
+                continue
+            fn = getattr(api, attr)
+            if callable(fn):
+                self.register(namespace, attr, fn)
+
+    def register_subscription(self, namespace: str, name: str,
+                              factory: Callable) -> None:
+        """factory(notify_fn, *params) -> cleanup_fn|None."""
+        self._sub_factories[f"{namespace}_{name}"] = factory
+
+    # --- dispatch ---------------------------------------------------------
+
+    def handle_raw(self, raw: bytes) -> bytes:
+        try:
+            payload = json.loads(raw)
+        except Exception:
+            return self._encode_error(None, PARSE_ERROR, "parse error")
+        if isinstance(payload, list):
+            if not payload:
+                return self._encode_error(None, INVALID_REQUEST, "empty batch")
+            out = [self._handle_one(req) for req in payload]
+            return json.dumps([json.loads(o) for o in out if o]).encode()
+        return self._handle_one(payload)
+
+    def _handle_one(self, req: dict) -> bytes:
+        req_id = req.get("id")
+        method = req.get("method")
+        if not isinstance(method, str):
+            return self._encode_error(req_id, INVALID_REQUEST, "missing method")
+        params = req.get("params", [])
+        fn = self._methods.get(method)
+        if fn is None:
+            return self._encode_error(
+                req_id, METHOD_NOT_FOUND, f"the method {method} does not exist"
+            )
+        try:
+            if isinstance(params, dict):
+                result = fn(**params)
+            else:
+                result = fn(*params)
+        except RPCError as e:
+            return self._encode_error(req_id, e.code, str(e), e.data)
+        except TypeError as e:
+            return self._encode_error(req_id, INVALID_PARAMS, str(e))
+        except Exception as e:
+            return self._encode_error(req_id, INTERNAL_ERROR, str(e))
+        return json.dumps(
+            {"jsonrpc": "2.0", "id": req_id, "result": result}
+        ).encode()
+
+    @staticmethod
+    def _encode_error(req_id, code: int, message: str, data=None) -> bytes:
+        err = {"code": code, "message": message}
+        if data is not None:
+            err["data"] = data
+        return json.dumps({"jsonrpc": "2.0", "id": req_id, "error": err}).encode()
+
+    # --- subscriptions ----------------------------------------------------
+
+    def subscribe(self, method: str, notify: Callable[[Any], None], *params) -> str:
+        factory = self._sub_factories.get(method)
+        if factory is None:
+            raise RPCError(METHOD_NOT_FOUND, f"no subscription {method}")
+        sub_id = "0x" + uuid.uuid4().hex
+        sub = Subscription(sub_id, notify)
+        with self.lock:
+            self._subscriptions[sub_id] = sub
+        factory(lambda item: self._notify(sub_id, item), *params)
+        return sub_id
+
+    def _notify(self, sub_id: str, item) -> None:
+        sub = self._subscriptions.get(sub_id)
+        if sub is not None and sub.active:
+            sub.notify(item)
+
+    def unsubscribe(self, sub_id: str) -> bool:
+        with self.lock:
+            sub = self._subscriptions.pop(sub_id, None)
+        if sub is not None:
+            sub.active = False
+            return True
+        return False
+
+    # --- HTTP transport ---------------------------------------------------
+
+    def serve_http(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Start the HTTP listener; returns the bound port."""
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                resp = server.handle_raw(body)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(resp)))
+                self.end_headers()
+                self.wfile.write(resp)
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        thread.start()
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None

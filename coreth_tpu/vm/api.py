@@ -1,0 +1,201 @@
+"""VM-level RPC APIs: avax namespace, admin, health (roles of
+/root/reference/plugin/evm/{service,admin,health}.go).
+
+create_handlers() assembles the full RPC surface the reference exposes at
+/ext/bc/C/{rpc,avax,admin} (vm.go:1138-1186 CreateHandlers).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..eth.api import EthAPI, hb, hx, parse_bytes
+from ..eth.backend import EthBackend
+from ..eth.tracers import DebugAPI
+from ..rpc.server import RPCError, RPCServer
+from .atomic_tx import Tx, decode_tx
+from .vm import ATOMIC_TX_INDEX_PREFIX
+
+
+class AvaxAPI:
+    """avax.* handlers (service.go:89-460): issueTx/getAtomicTx/getUTXOs."""
+
+    def __init__(self, vm):
+        self.vm = vm
+
+    def issueTx(self, tx_bytes: str) -> dict:
+        tx = decode_tx(parse_bytes(tx_bytes))
+        self.vm.issue_atomic_tx(tx)
+        return {"txID": hb(tx.id())}
+
+    def getAtomicTxStatus(self, tx_id: str) -> dict:
+        tid = parse_bytes(tx_id)
+        if self.vm.mempool.has(tid):
+            return {"status": "Processing"}
+        blob = self.vm.blockchain.diskdb.get(ATOMIC_TX_INDEX_PREFIX + tid)
+        if blob is not None:
+            height = int.from_bytes(blob[:8], "big")
+            return {"status": "Accepted", "blockHeight": hx(height)}
+        return {"status": "Unknown"}
+
+    def getAtomicTx(self, tx_id: str) -> dict:
+        tid = parse_bytes(tx_id)
+        tx = self.vm.mempool.get(tid)
+        if tx is not None:
+            return {"tx": hb(tx.encode()), "blockHeight": None}
+        blob = self.vm.blockchain.diskdb.get(ATOMIC_TX_INDEX_PREFIX + tid)
+        if blob is not None:
+            return {
+                "tx": hb(blob[8:]),
+                "blockHeight": hx(int.from_bytes(blob[:8], "big")),
+            }
+        raise RPCError(-32000, "transaction not found")
+
+    def getUTXOs(self, addresses, source_chain: str = "", limit: int = 100) -> dict:
+        """UTXOs owned by [addresses] in this chain's inbound namespace."""
+        if isinstance(addresses, str):
+            addresses = [addresses]
+        addrs = [parse_bytes(a) for a in addresses]
+        source = parse_bytes(source_chain) if source_chain else self.vm.ctx.x_chain_id
+        utxos, _, last = self.vm.shared_memory.indexed(
+            source, addrs, limit=limit
+        )
+        return {
+            "numFetched": hx(len(utxos)),
+            "utxos": [hb(u) for u in utxos],
+            "endIndex": hb(last) if last else None,
+        }
+
+    def version(self) -> dict:
+        return {"version": "coreth-tpu/0.1.0"}
+
+
+class AdminAPI:
+    """coreth-admin (admin.go:29-62)."""
+
+    def __init__(self, vm):
+        self.vm = vm
+        self.log_level = "info"
+
+    def setLogLevel(self, level: str) -> bool:
+        self.log_level = level
+        return True
+
+    def lockProfile(self) -> bool:
+        return True  # profiling hooks are host-side no-ops here
+
+    def memoryProfile(self) -> bool:
+        return True
+
+    def startCPUProfiler(self) -> bool:
+        return True
+
+    def stopCPUProfiler(self) -> bool:
+        return True
+
+
+class TxPoolAPI:
+    """txpool namespace (internal/ethapi TxPoolAPI)."""
+
+    def __init__(self, backend):
+        self.b = backend
+
+    def status(self) -> dict:
+        pending, queued = self.b.txpool.stats()
+        return {"pending": hx(pending), "queued": hx(queued)}
+
+    def content(self) -> dict:
+        out = {"pending": {}, "queued": {}}
+        for addr, txs in self.b.txpool.pending_txs().items():
+            out["pending"][hb(addr)] = {
+                str(t.nonce): hb(t.hash()) for t in txs
+            }
+        return out
+
+
+class NetAPI:
+    def __init__(self, network_id: int):
+        self._id = network_id
+
+    def version(self) -> str:
+        return str(self._id)
+
+    def listening(self) -> bool:
+        return True
+
+    def peerCount(self) -> str:
+        return hx(0)
+
+
+class Web3API:
+    def clientVersion(self) -> str:
+        return "coreth-tpu/0.1.0"
+
+    def sha3(self, data: str) -> str:
+        from ..native import keccak256
+
+        return hb(keccak256(parse_bytes(data)))
+
+
+class FiltersAPI:
+    """eth_newFilter family bridged onto the FilterSystem."""
+
+    def __init__(self, backend):
+        self.b = backend
+
+    def newFilter(self, crit: dict) -> str:
+        return self.b.filters.new_log_filter(crit)
+
+    def newBlockFilter(self) -> str:
+        return self.b.filters.new_block_filter()
+
+    def newPendingTransactionFilter(self) -> str:
+        return self.b.filters.new_pending_tx_filter()
+
+    def uninstallFilter(self, fid: str) -> bool:
+        return self.b.filters.uninstall(fid)
+
+    def getFilterChanges(self, fid: str) -> list:
+        items = self.b.filters.get_changes(fid)
+        out = []
+        api = EthAPI(self.b)
+        for item in items:
+            if isinstance(item, bytes):
+                out.append(hb(item))
+            else:
+                out.append(api._marshal_log(item, 0))
+        return out
+
+
+def health_check(vm) -> dict:
+    """health.go: the VM is healthy when the acceptor is alive."""
+    healthy = vm.blockchain.acceptor_error is None
+    return {
+        "healthy": healthy,
+        "lastAcceptedHeight": vm.blockchain.last_accepted.number,
+        "error": vm.blockchain.acceptor_error,
+    }
+
+
+def create_handlers(vm, allow_unfinalized_queries: bool = False) -> RPCServer:
+    """CreateHandlers (vm.go:1138): the full RPC surface on one server."""
+    backend = EthBackend(vm.blockchain, vm.txpool, allow_unfinalized_queries)
+    vm.eth_backend = backend
+    server = RPCServer()
+    eth = EthAPI(backend)
+    server.register_api("eth", eth)
+    filters_api = FiltersAPI(backend)
+    server.register("eth", "newFilter", filters_api.newFilter)
+    server.register("eth", "newBlockFilter", filters_api.newBlockFilter)
+    server.register("eth", "newPendingTransactionFilter",
+                    filters_api.newPendingTransactionFilter)
+    server.register("eth", "uninstallFilter", filters_api.uninstallFilter)
+    server.register("eth", "getFilterChanges", filters_api.getFilterChanges)
+    server.register_api("debug", DebugAPI(backend))
+    server.register_api("txpool", TxPoolAPI(backend))
+    server.register_api("net", NetAPI(vm.network_id))
+    server.register_api("web3", Web3API())
+    server.register_api("avax", AvaxAPI(vm))
+    server.register_api("admin", AdminAPI(vm))
+    server.register("health", "check", lambda: health_check(vm))
+    return server

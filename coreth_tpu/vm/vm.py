@@ -33,7 +33,12 @@ from .block import BlockStatus, VMBlock
 from .mempool import Mempool
 from .shared_memory import Requests
 
-AVAX_ASSET_ID = keccak_placeholder = b"\x41" * 32  # test default; ctx overrides
+AVAX_ASSET_ID = b"\x41" * 32  # test default; ctx overrides
+
+# accepted-atomic-tx index (atomic_tx_repository.go role). "Atx" cannot
+# collide with snapshot (b"a"/b"o"), header/body (b"h"/b"b"), code (b"c"),
+# or 32-byte trie-node keys.
+ATOMIC_TX_INDEX_PREFIX = b"Atx"
 
 
 @dataclass
@@ -300,7 +305,15 @@ class VM:
         """Accept-path shared memory commit (block.go:164-168): apply the
         tx's requests atomically with the VM db batch."""
         chain, requests = tx.atomic_ops()
-        self.shared_memory.apply({chain: requests})
+        # the tx index commits atomically with the shared-memory ops, like
+        # the reference's versiondb commit batch (block.go:164-168); the
+        # "Atx" prefix lives outside every 1-byte rawdb/snapshot namespace
+        batch = self.blockchain.diskdb.new_batch()
+        batch.put(
+            ATOMIC_TX_INDEX_PREFIX + tx.id(),
+            vmb.height().to_bytes(8, "big") + tx.encode(),
+        )
+        self.shared_memory.apply({chain: requests}, batch=batch)
         self.mempool.remove_tx(tx)
 
     # --- atomic tx issuance (vm.go:1297-1417) -----------------------------

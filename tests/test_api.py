@@ -1,0 +1,255 @@
+"""RPC API tests: JSON-RPC engine, eth namespace over a live chain,
+filters, gas oracle, tracers, avax/health (modeled on the reference's
+internal/ethapi + eth/filters + eth/tracers test suites)."""
+
+import json
+
+import pytest
+
+from coreth_tpu import params
+from coreth_tpu.core.genesis import Genesis, GenesisAccount
+from coreth_tpu.core.types import Signer, Transaction
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.evm import opcodes as OP
+from coreth_tpu.vm.api import create_handlers
+from coreth_tpu.vm.shared_memory import Memory
+from coreth_tpu.vm.vm import SnowContext, VM, VMConfig
+
+KEY = b"\x11" * 32
+ADDR = priv_to_address(KEY)
+DEST = b"\xbb" * 20
+FUND = 10**24
+
+# a contract that emits LOG1(topic=0x42...) and stores CALLVALUE
+EMITTER = bytes([
+    OP.PUSH1, 0x42, OP.PUSH1, 0x00, OP.MSTORE,        # mem[0..32] = 0x42
+    OP.PUSH32]) + (0x1234).to_bytes(32, "big") + bytes([
+    OP.PUSH1, 0x20, OP.PUSH1, 0x00, OP.LOG0 + 1,      # LOG1(data=mem[0:32], topic)
+    OP.STOP,
+])
+
+
+def rpc(server, method, *params_):
+    raw = server.handle_raw(json.dumps(
+        {"jsonrpc": "2.0", "id": 1, "method": method, "params": list(params_)}
+    ).encode())
+    resp = json.loads(raw)
+    if "error" in resp:
+        raise RuntimeError(resp["error"])
+    return resp["result"]
+
+
+@pytest.fixture(scope="module")
+def live_vm():
+    vm = VM()
+    genesis = Genesis(
+        config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+        alloc={
+            ADDR: GenesisAccount(balance=FUND),
+            b"\xee" * 20: GenesisAccount(code=EMITTER, balance=0),
+        },
+    )
+    clock = [0]
+
+    def tick():
+        clock[0] = vm.blockchain.current_block.time + 2
+        return clock[0]
+
+    vm.initialize(SnowContext(shared_memory=Memory()), MemoryDB(), genesis,
+                  VMConfig(clock=tick))
+    server = create_handlers(vm)
+    signer = Signer(43112)
+
+    def send_and_accept(*txs):
+        for t in txs:
+            vm.issue_tx(t)
+        blk = vm.build_block()
+        blk.verify()
+        blk.accept()
+        vm.blockchain.drain_acceptor_queue()
+        return blk
+
+    # block 1: plain transfer; block 2: call the emitter (produces a log)
+    t1 = signer.sign(Transaction(type=2, chain_id=43112, nonce=0,
+                                 max_fee=10**12, max_priority_fee=10**9,
+                                 gas=21000, to=DEST, value=12345), KEY)
+    b1 = send_and_accept(t1)
+    t2 = signer.sign(Transaction(type=2, chain_id=43112, nonce=1,
+                                 max_fee=10**12, max_priority_fee=10**9,
+                                 gas=100_000, to=b"\xee" * 20, value=0), KEY)
+    b2 = send_and_accept(t2)
+    yield vm, server, (t1, b1), (t2, b2)
+    vm.shutdown()
+    server.stop()
+
+
+class TestEthNamespace:
+    def test_chain_id_and_block_number(self, live_vm):
+        vm, server, _, _ = live_vm
+        assert int(rpc(server, "eth_chainId"), 16) == 43112
+        assert int(rpc(server, "eth_blockNumber"), 16) == 2
+
+    def test_get_balance(self, live_vm):
+        vm, server, _, _ = live_vm
+        bal = int(rpc(server, "eth_getBalance", "0x" + DEST.hex(), "latest"), 16)
+        assert bal == 12345
+
+    def test_get_block_by_number(self, live_vm):
+        vm, server, (t1, b1), _ = live_vm
+        blk = rpc(server, "eth_getBlockByNumber", "0x1", True)
+        assert int(blk["number"], 16) == 1
+        assert blk["hash"] == "0x" + b1.id().hex()
+        assert len(blk["transactions"]) == 1
+        assert blk["transactions"][0]["hash"] == "0x" + t1.hash().hex()
+        assert "baseFeePerGas" in blk
+
+    def test_get_transaction_and_receipt(self, live_vm):
+        vm, server, (t1, b1), _ = live_vm
+        h = "0x" + t1.hash().hex()
+        tx = rpc(server, "eth_getTransactionByHash", h)
+        assert tx["from"] == "0x" + ADDR.hex()
+        assert int(tx["value"], 16) == 12345
+        r = rpc(server, "eth_getTransactionReceipt", h)
+        assert int(r["status"], 16) == 1
+        assert int(r["gasUsed"], 16) == 21000
+
+    def test_call_and_estimate(self, live_vm):
+        vm, server, _, _ = live_vm
+        out = rpc(server, "eth_call",
+                  {"to": "0x" + (b"\xee" * 20).hex(), "from": "0x" + ADDR.hex()},
+                  "latest")
+        assert out == "0x"
+        gas = int(rpc(server, "eth_estimateGas",
+                      {"to": "0x" + DEST.hex(), "from": "0x" + ADDR.hex(),
+                       "value": "0x1"}), 16)
+        assert gas == 21000
+
+    def test_send_raw_transaction(self, live_vm):
+        vm, server, _, _ = live_vm
+        signer = Signer(43112)
+        t = signer.sign(Transaction(type=2, chain_id=43112, nonce=2,
+                                    max_fee=10**12, max_priority_fee=10**9,
+                                    gas=21000, to=DEST, value=7), KEY)
+        h = rpc(server, "eth_sendRawTransaction", "0x" + t.encode().hex())
+        assert h == "0x" + t.hash().hex()
+        assert vm.txpool.has(t.hash())
+
+    def test_get_logs(self, live_vm):
+        vm, server, _, (t2, b2) = live_vm
+        logs = rpc(server, "eth_getLogs", {
+            "fromBlock": "0x0", "toBlock": "0x2",
+            "address": "0x" + (b"\xee" * 20).hex(),
+        })
+        assert len(logs) == 1
+        assert logs[0]["topics"] == ["0x" + (0x1234).to_bytes(32, "big").hex()]
+        # topic filter excludes
+        logs2 = rpc(server, "eth_getLogs", {
+            "fromBlock": "0x0", "toBlock": "0x2",
+            "topics": ["0x" + (0x9999).to_bytes(32, "big").hex()],
+        })
+        assert logs2 == []
+
+    def test_unfinalized_query_rejected(self, live_vm):
+        vm, server, _, _ = live_vm
+        with pytest.raises(RuntimeError) as e:
+            rpc(server, "eth_getBlockByNumber", "0x64", False)
+        assert "unfinalized" in str(e.value)
+
+    def test_fee_apis(self, live_vm):
+        vm, server, _, _ = live_vm
+        assert int(rpc(server, "eth_gasPrice"), 16) > 0
+        hist = rpc(server, "eth_feeHistory", 2, "latest", [50])
+        assert len(hist["baseFeePerGas"]) == 3  # 2 blocks + next
+        assert len(hist["reward"]) == 2
+
+
+class TestFilters:
+    def test_block_and_log_filters(self, live_vm):
+        vm, server, _, _ = live_vm
+        bf = rpc(server, "eth_newBlockFilter")
+        lf = rpc(server, "eth_newFilter",
+                 {"address": "0x" + (b"\xee" * 20).hex()})
+        signer = Signer(43112)
+        nonce = vm.txpool.nonce(ADDR)
+        t = signer.sign(Transaction(type=2, chain_id=43112, nonce=nonce,
+                                    max_fee=10**12, max_priority_fee=10**9,
+                                    gas=100_000, to=b"\xee" * 20), KEY)
+        vm.issue_tx(t)
+        blk = vm.build_block()
+        blk.verify()
+        blk.accept()
+        vm.blockchain.drain_acceptor_queue()
+        changes = rpc(server, "eth_getFilterChanges", bf)
+        assert "0x" + blk.id().hex() in changes
+        log_changes = rpc(server, "eth_getFilterChanges", lf)
+        assert len(log_changes) == 1
+        assert rpc(server, "eth_uninstallFilter", bf) is True
+
+
+class TestDebugTracers:
+    def test_struct_logger_trace(self, live_vm):
+        vm, server, _, (t2, b2) = live_vm
+        trace = rpc(server, "debug_traceTransaction", "0x" + t2.hash().hex())
+        assert trace["failed"] is False
+        ops = [l["op"] for l in trace["structLogs"]]
+        assert "LOG1" in ops and "MSTORE" in ops
+
+    def test_call_tracer(self, live_vm):
+        vm, server, _, (t2, b2) = live_vm
+        trace = rpc(server, "debug_traceTransaction", "0x" + t2.hash().hex(),
+                    {"tracer": "callTracer"})
+        assert trace["type"] == "CALL"
+        assert trace["to"] == "0x" + (b"\xee" * 20).hex()
+
+    def test_trace_block(self, live_vm):
+        vm, server, _, (t2, b2) = live_vm
+        traces = rpc(server, "debug_traceBlockByNumber", "0x2")
+        assert len(traces) == 1
+        assert traces[0]["txHash"] == "0x" + t2.hash().hex()
+
+
+class TestMisc:
+    def test_txpool_net_web3(self, live_vm):
+        vm, server, _, _ = live_vm
+        status = rpc(server, "txpool_status")
+        assert "pending" in status
+        assert rpc(server, "net_version") == "1337"
+        h = rpc(server, "web3_sha3", "0x")
+        assert h == "0x" + "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+
+    def test_health(self, live_vm):
+        vm, server, _, _ = live_vm
+        out = rpc(server, "health_check")
+        assert out["healthy"] is True
+
+    def test_batch_request(self, live_vm):
+        vm, server, _, _ = live_vm
+        raw = server.handle_raw(json.dumps([
+            {"jsonrpc": "2.0", "id": 1, "method": "eth_chainId", "params": []},
+            {"jsonrpc": "2.0", "id": 2, "method": "eth_blockNumber", "params": []},
+        ]).encode())
+        out = json.loads(raw)
+        assert len(out) == 2
+
+    def test_method_not_found(self, live_vm):
+        vm, server, _, _ = live_vm
+        raw = server.handle_raw(json.dumps(
+            {"jsonrpc": "2.0", "id": 1, "method": "eth_nope", "params": []}
+        ).encode())
+        assert json.loads(raw)["error"]["code"] == -32601
+
+    def test_http_transport(self, live_vm):
+        import urllib.request
+
+        vm, server, _, _ = live_vm
+        port = server.serve_http()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}",
+            data=json.dumps({"jsonrpc": "2.0", "id": 1,
+                             "method": "eth_chainId", "params": []}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            out = json.loads(resp.read())
+        assert int(out["result"], 16) == 43112
