@@ -1,0 +1,212 @@
+"""Encrypted key storage (role of /root/reference/accounts/keystore/ —
+the Web3 Secret Storage v3 format: scrypt/pbkdf2 KDF + AES-128-CTR +
+keccak-256 MAC, key.go/passphrase.go).
+
+KeyStore watches a directory of JSON key files and signs with unlocked
+keys, like accounts/keystore/keystore.go.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import secrets
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+from ..crypto.secp256k1 import priv_to_address, sign
+from ..native import keccak256
+
+STANDARD_SCRYPT_N = 1 << 18
+STANDARD_SCRYPT_P = 1
+LIGHT_SCRYPT_N = 1 << 12
+LIGHT_SCRYPT_P = 6
+SCRYPT_R = 8
+SCRYPT_DKLEN = 32
+
+
+class KeyStoreError(Exception):
+    pass
+
+
+ErrDecrypt = "could not decrypt key with given password"
+ErrLocked = "password or unlock"
+ErrNoMatch = "no key for given address or file"
+
+
+def _aes_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    cipher = Cipher(algorithms.AES(key), modes.CTR(iv))
+    enc = cipher.encryptor()
+    return enc.update(data) + enc.finalize()
+
+
+def encrypt_key(priv: bytes, password: str, light: bool = False) -> dict:
+    """EncryptKey (passphrase.go): produce a v3 keyfile JSON object."""
+    n = LIGHT_SCRYPT_N if light else STANDARD_SCRYPT_N
+    p = LIGHT_SCRYPT_P if light else STANDARD_SCRYPT_P
+    salt = secrets.token_bytes(32)
+    derived = hashlib.scrypt(
+        password.encode(), salt=salt, n=n, r=SCRYPT_R, p=p,
+        dklen=SCRYPT_DKLEN, maxmem=2**31 - 1,
+    )
+    enc_key = derived[:16]
+    iv = secrets.token_bytes(16)
+    ciphertext = _aes_ctr(enc_key, iv, priv)
+    mac = keccak256(derived[16:32] + ciphertext)
+    return {
+        "address": priv_to_address(priv).hex(),
+        "crypto": {
+            "cipher": "aes-128-ctr",
+            "ciphertext": ciphertext.hex(),
+            "cipherparams": {"iv": iv.hex()},
+            "kdf": "scrypt",
+            "kdfparams": {
+                "dklen": SCRYPT_DKLEN, "n": n, "p": p, "r": SCRYPT_R,
+                "salt": salt.hex(),
+            },
+            "mac": mac.hex(),
+        },
+        "id": str(uuid.uuid4()),
+        "version": 3,
+    }
+
+
+def decrypt_key(keyjson: dict, password: str) -> bytes:
+    """DecryptKey (passphrase.go): v3 with scrypt or pbkdf2."""
+    if keyjson.get("version") != 3:
+        raise KeyStoreError(f"unsupported key version {keyjson.get('version')}")
+    crypto = keyjson["crypto"]
+    if crypto["cipher"] != "aes-128-ctr":
+        raise KeyStoreError(f"unsupported cipher {crypto['cipher']}")
+    kdf = crypto["kdf"]
+    kp = crypto["kdfparams"]
+    salt = bytes.fromhex(kp["salt"])
+    if kdf == "scrypt":
+        derived = hashlib.scrypt(
+            password.encode(), salt=salt, n=kp["n"], r=kp["r"], p=kp["p"],
+            dklen=kp["dklen"], maxmem=2**31 - 1,
+        )
+    elif kdf == "pbkdf2":
+        if kp.get("prf", "hmac-sha256") != "hmac-sha256":
+            raise KeyStoreError("unsupported pbkdf2 prf")
+        derived = hashlib.pbkdf2_hmac(
+            "sha256", password.encode(), salt, kp["c"], kp["dklen"]
+        )
+    else:
+        raise KeyStoreError(f"unsupported kdf {kdf}")
+    ciphertext = bytes.fromhex(crypto["ciphertext"])
+    mac = keccak256(derived[16:32] + ciphertext)
+    if mac.hex() != crypto["mac"]:
+        raise KeyStoreError(ErrDecrypt)
+    iv = bytes.fromhex(crypto["cipherparams"]["iv"])
+    priv = _aes_ctr(derived[:16], iv, ciphertext)
+    return priv
+
+
+class Account:
+    def __init__(self, address: bytes, url: str = ""):
+        self.address = address
+        self.url = url
+
+
+class KeyStore:
+    """Directory-backed keystore with unlock/lock (keystore.go)."""
+
+    def __init__(self, keydir: str, light: bool = True):
+        self.keydir = keydir
+        self.light = light
+        self.lock = threading.Lock()
+        self._unlocked: Dict[bytes, bytes] = {}  # address -> priv
+        os.makedirs(keydir, exist_ok=True)
+
+    # --- account management ----------------------------------------------
+
+    def accounts(self) -> List[Account]:
+        out = []
+        for name in sorted(os.listdir(self.keydir)):
+            path = os.path.join(self.keydir, name)
+            try:
+                with open(path) as f:
+                    kj = json.load(f)
+                out.append(Account(bytes.fromhex(kj["address"]), path))
+            except Exception:
+                continue
+        return out
+
+    def new_account(self, password: str) -> Account:
+        priv = secrets.token_bytes(32)
+        return self.import_key(priv, password)
+
+    def import_key(self, priv: bytes, password: str) -> Account:
+        kj = encrypt_key(priv, password, light=self.light)
+        addr = priv_to_address(priv)
+        ts = time.strftime("%Y-%m-%dT%H-%M-%S", time.gmtime())
+        name = f"UTC--{ts}--{addr.hex()}"
+        path = os.path.join(self.keydir, name)
+        with open(path, "w") as f:
+            json.dump(kj, f)
+        os.chmod(path, 0o600)
+        return Account(addr, path)
+
+    def export_key(self, address: bytes, password: str) -> bytes:
+        kj = self._find(address)
+        return decrypt_key(kj, password)
+
+    def delete(self, address: bytes, password: str) -> None:
+        self.export_key(address, password)  # password check
+        for acct in self.accounts():
+            if acct.address == address:
+                os.remove(acct.url)
+                return
+        raise KeyStoreError(ErrNoMatch)
+
+    def _find(self, address: bytes) -> dict:
+        for acct in self.accounts():
+            if acct.address == address:
+                with open(acct.url) as f:
+                    return json.load(f)
+        raise KeyStoreError(ErrNoMatch)
+
+    # --- unlock / signing -------------------------------------------------
+
+    def unlock(self, address: bytes, password: str,
+               timeout: Optional[float] = None) -> None:
+        priv = self.export_key(address, password)
+        with self.lock:
+            self._unlocked[address] = priv
+        if timeout:
+            t = threading.Timer(timeout, lambda: self.lock_account(address))
+            t.daemon = True
+            t.start()
+
+    def lock_account(self, address: bytes) -> None:
+        with self.lock:
+            self._unlocked.pop(address, None)
+
+    def sign_hash(self, address: bytes, digest: bytes) -> bytes:
+        with self.lock:
+            priv = self._unlocked.get(address)
+        if priv is None:
+            raise KeyStoreError(ErrLocked)
+        v, r, s = sign(digest, priv)
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([v])
+
+    def sign_tx(self, address: bytes, tx, chain_id: int):
+        from ..core.types import Signer
+
+        with self.lock:
+            priv = self._unlocked.get(address)
+        if priv is None:
+            raise KeyStoreError(ErrLocked)
+        return Signer(chain_id).sign(tx, priv)
+
+    def sign_hash_with_passphrase(self, address: bytes, password: str,
+                                  digest: bytes) -> bytes:
+        priv = self.export_key(address, password)
+        v, r, s = sign(digest, priv)
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([v])

@@ -312,6 +312,9 @@ class BlockChain:
             self._insert_block(block, writes)
 
     def _insert_block(self, block: Block, writes: bool) -> None:
+        from ..metrics import default_registry as _metrics
+
+        insert_timer = _metrics.timer("chain/block/inserts")
         header = block.header
         parent = self.get_header(header.parent_hash)
         if parent is None:
@@ -328,11 +331,17 @@ class BlockChain:
 
         statedb = self.state_at(parent.root)
 
-        receipts, logs, used_gas = self.processor.process(block, parent, statedb)
-        self.validator.validate_state(block, statedb, receipts, used_gas)
+        with insert_timer.time():
+            receipts, logs, used_gas = self.processor.process(block, parent, statedb)
+            self.validator.validate_state(block, statedb, receipts, used_gas)
 
         if not writes:
             return
+
+        # count only committed inserts: locally built blocks run a
+        # writes=False pre-verification first and must not double-count
+        _metrics.meter("chain/txs/processed").mark(len(block.transactions))
+        _metrics.meter("chain/gas/used").mark(used_gas)
 
         # commit state: trie refs live until Accept/Reject balance them;
         # block hashes key the snapshot diff layer (coreth CommitWithSnap)
@@ -451,9 +460,13 @@ class BlockChain:
 
     def _accept_post_process(self, block: Block) -> None:
         """startAcceptor body (blockchain.go:563-611)."""
-        if self.snaps is not None:
-            self.snaps.flatten(block.hash())
-        self.trie_writer.accept_trie(block)
+        from ..metrics import default_registry as _metrics
+
+        with _metrics.timer("chain/block/accepts").time():
+            if self.snaps is not None:
+                self.snaps.flatten(block.hash())
+            self.trie_writer.accept_trie(block)
+        _metrics.gauge("chain/head/accepted").update(block.number)
         for i, tx in enumerate(block.transactions):
             rawdb.write_tx_lookup(self.diskdb, tx.hash(), block.number)
         receipts = self.get_receipts(block.hash()) or []

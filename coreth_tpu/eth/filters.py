@@ -57,10 +57,17 @@ class FilterSystem:
 
     def _on_accepted(self, block, logs) -> None:
         with self.lock:
+            self._expire_stale()  # abandoned filters must not grow forever
             for f in self.filters.values():
                 if f.typ == "blocks":
                     f.items.append(block.hash())
                 elif f.typ == "logs":
+                    # honor the filter's block range, not just addr/topics
+                    lo, hi = f.crit.get("from"), f.crit.get("to")
+                    if lo is not None and block.number < lo:
+                        continue
+                    if hi is not None and block.number > hi:
+                        continue
                     f.items.extend(self._filter_logs(logs, f.crit))
 
     def _on_new_txs(self, txs) -> None:
@@ -128,10 +135,15 @@ class FilterSystem:
         if crit.get("blockHash"):
             out["block_hash"] = parse_bytes(crit["blockHash"])
         else:
-            if crit.get("fromBlock") not in (None, "latest", "pending"):
-                out["from"] = parse_hex(crit["fromBlock"])
-            if crit.get("toBlock") not in (None, "latest", "pending"):
-                out["to"] = parse_hex(crit["toBlock"])
+            def tag_to_number(tag):
+                if tag in (None, "latest", "accepted", "pending"):
+                    return None
+                if tag == "earliest":
+                    return 0
+                return parse_hex(tag)
+
+            out["from"] = tag_to_number(crit.get("fromBlock"))
+            out["to"] = tag_to_number(crit.get("toBlock"))
         return out
 
     def _filter_logs(self, logs, crit: dict) -> list:

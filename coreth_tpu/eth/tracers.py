@@ -172,12 +172,42 @@ class DebugAPI:
 
 
 def _instrument_call_tracer(evm: EVM, tracer: CallTracer) -> EVM:
-    """Wrap the EVM call/create surface to emit call frames."""
-    orig_call, orig_create = evm.call, evm._create
+    """Wrap the whole EVM call family to emit call frames (the interpreter
+    dispatches DELEGATECALL/STATICCALL/CALLCODE/CALLEX to distinct methods)."""
+    orig_call = evm.call
+    orig_call_code = evm.call_code
+    orig_delegate = evm.delegate_call
+    orig_static = evm.static_call
+    orig_expert = evm.call_expert
+    orig_create = evm._create
 
     def call(caller, addr, input_, gas, value):
         tracer.enter("CALL", caller, addr, value, gas, input_)
         ret, left, err = orig_call(caller, addr, input_, gas, value)
+        tracer.exit(ret, gas - left, str(err) if err else None)
+        return ret, left, err
+
+    def call_code(caller, addr, input_, gas, value):
+        tracer.enter("CALLCODE", caller, addr, value, gas, input_)
+        ret, left, err = orig_call_code(caller, addr, input_, gas, value)
+        tracer.exit(ret, gas - left, str(err) if err else None)
+        return ret, left, err
+
+    def delegate_call(parent, addr, input_, gas):
+        tracer.enter("DELEGATECALL", parent.address, addr, 0, gas, input_)
+        ret, left, err = orig_delegate(parent, addr, input_, gas)
+        tracer.exit(ret, gas - left, str(err) if err else None)
+        return ret, left, err
+
+    def static_call(caller, addr, input_, gas):
+        tracer.enter("STATICCALL", caller, addr, 0, gas, input_)
+        ret, left, err = orig_static(caller, addr, input_, gas)
+        tracer.exit(ret, gas - left, str(err) if err else None)
+        return ret, left, err
+
+    def call_expert(caller, addr, input_, gas, value, coin_id, value2):
+        tracer.enter("CALLEX", caller, addr, value, gas, input_)
+        ret, left, err = orig_expert(caller, addr, input_, gas, value, coin_id, value2)
         tracer.exit(ret, gas - left, str(err) if err else None)
         return ret, left, err
 
@@ -188,5 +218,9 @@ def _instrument_call_tracer(evm: EVM, tracer: CallTracer) -> EVM:
         return ret, out_addr, left, err
 
     evm.call = call
+    evm.call_code = call_code
+    evm.delegate_call = delegate_call
+    evm.static_call = static_call
+    evm.call_expert = call_expert
     evm._create = create
     return evm

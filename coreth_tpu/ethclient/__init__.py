@@ -1,0 +1,101 @@
+"""RPC client (role of /root/reference/ethclient/ + corethclient —
+accepted-head semantics). Speaks JSON-RPC over HTTP or directly against an
+in-process RPCServer."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, List, Optional
+
+from ..core.types import Transaction
+
+
+class ClientError(Exception):
+    def __init__(self, code, message, data=None):
+        super().__init__(message)
+        self.code = code
+        self.data = data
+
+
+class Client:
+    def __init__(self, url: str = "", server=None):
+        """Either an HTTP url or an in-process RPCServer."""
+        self.url = url
+        self.server = server
+        self._id = 0
+
+    def call_raw(self, method: str, *params) -> Any:
+        self._id += 1
+        payload = json.dumps({
+            "jsonrpc": "2.0", "id": self._id, "method": method,
+            "params": list(params),
+        }).encode()
+        if self.server is not None:
+            raw = self.server.handle_raw(payload)
+        else:
+            req = urllib.request.Request(
+                self.url, data=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                raw = resp.read()
+        out = json.loads(raw)
+        if "error" in out:
+            e = out["error"]
+            raise ClientError(e.get("code"), e.get("message"), e.get("data"))
+        return out["result"]
+
+    # --- typed surface (ethclient.go) -------------------------------------
+
+    def chain_id(self) -> int:
+        return int(self.call_raw("eth_chainId"), 16)
+
+    def block_number(self) -> int:
+        return int(self.call_raw("eth_blockNumber"), 16)
+
+    def balance_at(self, address: bytes, block: str = "latest") -> int:
+        return int(self.call_raw("eth_getBalance", "0x" + address.hex(), block), 16)
+
+    def asset_balance_at(self, address: bytes, asset_id: bytes,
+                         block: str = "latest") -> int:
+        return int(self.call_raw(
+            "eth_getAssetBalance", "0x" + address.hex(), block,
+            "0x" + asset_id.hex(),
+        ), 16)
+
+    def nonce_at(self, address: bytes, block: str = "latest") -> int:
+        return int(self.call_raw(
+            "eth_getTransactionCount", "0x" + address.hex(), block), 16)
+
+    def code_at(self, address: bytes, block: str = "latest") -> bytes:
+        return bytes.fromhex(self.call_raw(
+            "eth_getCode", "0x" + address.hex(), block)[2:])
+
+    def storage_at(self, address: bytes, slot: int, block: str = "latest") -> bytes:
+        return bytes.fromhex(self.call_raw(
+            "eth_getStorageAt", "0x" + address.hex(), hex(slot), block)[2:])
+
+    def send_transaction(self, tx: Transaction) -> bytes:
+        out = self.call_raw("eth_sendRawTransaction", "0x" + tx.encode().hex())
+        return bytes.fromhex(out[2:])
+
+    def transaction_receipt(self, tx_hash: bytes) -> Optional[dict]:
+        return self.call_raw("eth_getTransactionReceipt", "0x" + tx_hash.hex())
+
+    def block_by_number(self, number: Optional[int] = None, full: bool = False) -> Optional[dict]:
+        tag = "latest" if number is None else hex(number)
+        return self.call_raw("eth_getBlockByNumber", tag, full)
+
+    def call_contract(self, call_obj: dict, block: str = "latest") -> bytes:
+        out = self.call_raw("eth_call", call_obj, block)
+        return bytes.fromhex(out[2:])
+
+    def estimate_gas(self, call_obj: dict) -> int:
+        return int(self.call_raw("eth_estimateGas", call_obj), 16)
+
+    def suggest_gas_price(self) -> int:
+        return int(self.call_raw("eth_gasPrice"), 16)
+
+    def get_logs(self, criteria: dict) -> List[dict]:
+        return self.call_raw("eth_getLogs", criteria)

@@ -1,0 +1,206 @@
+"""Metrics registry (role of /root/reference/metrics/ — the go-metrics
+fork: counters, gauges, meters, histograms, timers, with the
+EnabledExpensive gate and Prometheus-style export)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+enabled = True
+enabled_expensive = False  # metrics.EnabledExpensive gate
+
+
+class Counter:
+    def __init__(self):
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    def dec(self, n: int = 1) -> None:
+        with self._lock:
+            self._v -= n
+
+    def count(self) -> int:
+        return self._v
+
+    def clear(self) -> None:
+        with self._lock:
+            self._v = 0
+
+
+class Gauge:
+    def __init__(self):
+        self._v = 0.0
+
+    def update(self, v) -> None:
+        self._v = v
+
+    def value(self):
+        return self._v
+
+
+class Histogram:
+    """Sampling histogram with percentile queries."""
+
+    def __init__(self, reservoir: int = 1028):
+        self._samples: List[float] = []
+        self._reservoir = reservoir
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def update(self, v: float) -> None:
+        with self._lock:
+            self._count += 1
+            if len(self._samples) < self._reservoir:
+                self._samples.append(v)
+            else:
+                import random
+
+                i = random.randrange(self._count)
+                if i < self._reservoir:
+                    self._samples[i] = v
+
+    def count(self) -> int:
+        return self._count
+
+    def mean(self) -> float:
+        with self._lock:
+            return sum(self._samples) / len(self._samples) if self._samples else 0.0
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            s = sorted(self._samples)
+            return s[min(len(s) - 1, int(len(s) * p))]
+
+
+class Meter:
+    """Rate meter (events/sec with total count)."""
+
+    def __init__(self):
+        self._count = 0
+        self._start = time.monotonic()
+        self._lock = threading.Lock()
+
+    def mark(self, n: int = 1) -> None:
+        with self._lock:
+            self._count += n
+
+    def count(self) -> int:
+        return self._count
+
+    def rate_mean(self) -> float:
+        elapsed = time.monotonic() - self._start
+        return self._count / elapsed if elapsed > 0 else 0.0
+
+
+class Timer:
+    """Histogram of durations + a meter of calls."""
+
+    def __init__(self):
+        self.hist = Histogram()
+        self.meter = Meter()
+
+    def update(self, seconds: float) -> None:
+        self.hist.update(seconds)
+        self.meter.mark()
+
+    def time(self):
+        timer = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = time.monotonic()
+                return self
+
+            def __exit__(self, *a):
+                timer.update(time.monotonic() - self.t0)
+
+        return _Ctx()
+
+    def count(self) -> int:
+        return self.meter.count()
+
+    def mean(self) -> float:
+        return self.hist.mean()
+
+
+class Registry:
+    """metrics.Registry: name → metric, lazily created."""
+
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_register(self, name: str, factory):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get_or_register(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get_or_register(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get_or_register(name, Histogram)
+
+    def meter(self, name: str) -> Meter:
+        return self._get_or_register(name, Meter)
+
+    def timer(self, name: str) -> Timer:
+        return self._get_or_register(name, Timer)
+
+    def each(self):
+        with self._lock:
+            return list(self._metrics.items())
+
+    def export_prometheus(self) -> str:
+        """Text exposition (the avalanchego gatherer analog)."""
+        lines = []
+        for name, m in self.each():
+            metric_name = name.replace("/", "_").replace(".", "_")
+            if isinstance(m, Counter):
+                lines.append(f"{metric_name} {m.count()}")
+            elif isinstance(m, Gauge):
+                lines.append(f"{metric_name} {m.value()}")
+            elif isinstance(m, Meter):
+                lines.append(f"{metric_name}_total {m.count()}")
+                lines.append(f"{metric_name}_rate {m.rate_mean():.6f}")
+            elif isinstance(m, Histogram):
+                lines.append(f"{metric_name}_count {m.count()}")
+                lines.append(f"{metric_name}_mean {m.mean():.6f}")
+            elif isinstance(m, Timer):
+                lines.append(f"{metric_name}_count {m.count()}")
+                lines.append(f"{metric_name}_mean_seconds {m.mean():.6f}")
+        return "\n".join(lines) + "\n"
+
+
+# default registry (metrics.DefaultRegistry)
+default_registry = Registry()
+
+
+def get_or_register_counter(name: str, registry: Optional[Registry] = None) -> Counter:
+    return (registry or default_registry).counter(name)
+
+
+def get_or_register_timer(name: str, registry: Optional[Registry] = None) -> Timer:
+    return (registry or default_registry).timer(name)
+
+
+def get_or_register_meter(name: str, registry: Optional[Registry] = None) -> Meter:
+    return (registry or default_registry).meter(name)
+
+
+def get_or_register_gauge(name: str, registry: Optional[Registry] = None) -> Gauge:
+    return (registry or default_registry).gauge(name)

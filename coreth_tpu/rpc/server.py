@@ -78,7 +78,9 @@ class RPCServer:
             return json.dumps([json.loads(o) for o in out if o]).encode()
         return self._handle_one(payload)
 
-    def _handle_one(self, req: dict) -> bytes:
+    def _handle_one(self, req) -> bytes:
+        if not isinstance(req, dict):
+            return self._encode_error(None, INVALID_REQUEST, "invalid request")
         req_id = req.get("id")
         method = req.get("method")
         if not isinstance(method, str):

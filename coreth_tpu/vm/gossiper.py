@@ -1,0 +1,111 @@
+"""Transaction gossip (role of /root/reference/plugin/evm/gossiper.go).
+
+Gossips new eth/atomic txs to peers and handles inbound gossip into the
+pools; regossip loops re-broadcast the highest-value pending txs on a
+ticker (gossiper.go:223-241,423-523).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from .. import rlp
+from ..core.types import Transaction
+from .atomic_tx import decode_tx
+
+GOSSIP_ETH_TXS = 0
+GOSSIP_ATOMIC_TX = 1
+
+REGOSSIP_INTERVAL = 60.0     # gossiper.go regossipFrequency
+MAX_TXS_PER_GOSSIP = 16
+
+
+def encode_tx_gossip(txs: List[Transaction]) -> bytes:
+    return bytes([GOSSIP_ETH_TXS]) + rlp.encode([t.encode() for t in txs])
+
+
+def encode_atomic_gossip(tx) -> bytes:
+    return bytes([GOSSIP_ATOMIC_TX]) + tx.encode()
+
+
+class Gossiper:
+    def __init__(self, vm, network):
+        self.vm = vm
+        self.network = network
+        self._recently_gossiped: set = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._regossip_thread: Optional[threading.Thread] = None
+
+        network.subscribe_gossip(self.handle_gossip)
+        vm.txpool.subscribe_new_txs(self.gossip_new_txs)
+
+    # --- outbound ---------------------------------------------------------
+
+    def gossip_new_txs(self, txs: List[Transaction]) -> None:
+        """GossipEthTxs (gossiper.go:479): fan out fresh pool entries."""
+        fresh = []
+        with self._lock:
+            for t in txs:
+                h = t.hash()
+                if h not in self._recently_gossiped:
+                    self._recently_gossiped.add(h)
+                    fresh.append(t)
+            if len(self._recently_gossiped) > 4096:
+                self._recently_gossiped = set(list(self._recently_gossiped)[-2048:])
+        for i in range(0, len(fresh), MAX_TXS_PER_GOSSIP):
+            self.network.gossip(encode_tx_gossip(fresh[i:i + MAX_TXS_PER_GOSSIP]))
+
+    def gossip_atomic_tx(self, tx) -> None:
+        self.network.gossip(encode_atomic_gossip(tx))
+
+    def start_regossip(self) -> None:
+        """Regossip ticker (gossiper.go:223-241)."""
+
+        def loop():
+            while not self._stop.wait(REGOSSIP_INTERVAL):
+                self.regossip()
+
+        self._regossip_thread = threading.Thread(target=loop, daemon=True)
+        self._regossip_thread.start()
+
+    def regossip(self) -> None:
+        pending = self.vm.txpool.pending_txs()
+        best: List[Transaction] = []
+        for txs in pending.values():
+            if txs:
+                best.append(txs[0])  # lowest-nonce executable per account
+        best.sort(key=lambda t: -t.gas_tip_cap)
+        if best:
+            self.network.gossip(encode_tx_gossip(best[:MAX_TXS_PER_GOSSIP]))
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # --- inbound ----------------------------------------------------------
+
+    def handle_gossip(self, sender: bytes, payload: bytes) -> None:
+        """GossipHandler.HandleEthTxs/HandleAtomicTx (gossiper.go:423-479)."""
+        if not payload:
+            return
+        kind, body = payload[0], payload[1:]
+        try:
+            if kind == GOSSIP_ETH_TXS:
+                for blob in rlp.decode(body):
+                    tx = Transaction.decode(bytes(blob) if not isinstance(blob, list)
+                                            else rlp.encode(blob))
+                    try:
+                        self.vm.txpool.add_remote(tx)
+                    except Exception:
+                        pass
+            elif kind == GOSSIP_ATOMIC_TX:
+                tx = decode_tx(body)
+                try:
+                    tx.semantic_verify(self.vm, self.vm._next_base_fee())
+                    self.vm.mempool.add(tx)
+                except Exception:
+                    pass
+        except Exception:
+            pass  # malformed gossip is dropped, never fatal

@@ -1,0 +1,276 @@
+"""Keystore, ABI, ethclient, gossiper, metrics tests (modeled on
+/root/reference/accounts/keystore/passphrase_test.go, accounts/abi/
+abi_test.go, ethclient usage, plugin/evm/gossiper_eth_gossiping_test.go)."""
+
+import json
+
+import pytest
+
+from coreth_tpu import params
+from coreth_tpu.accounts.abi import ABI, ABIError, pack_values, parse_type, unpack_values
+from coreth_tpu.accounts.keystore import (
+    KeyStore,
+    KeyStoreError,
+    decrypt_key,
+    encrypt_key,
+)
+from coreth_tpu.core.genesis import Genesis, GenesisAccount
+from coreth_tpu.core.types import Signer, Transaction
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.native import keccak256
+
+KEY = b"\x11" * 32
+ADDR = priv_to_address(KEY)
+DEST = b"\xbb" * 20
+
+
+class TestKeystore:
+    def test_encrypt_decrypt_round_trip(self):
+        kj = encrypt_key(KEY, "hunter2", light=True)
+        assert kj["version"] == 3
+        assert kj["address"] == ADDR.hex()
+        assert decrypt_key(kj, "hunter2") == KEY
+
+    def test_wrong_password_rejected(self):
+        kj = encrypt_key(KEY, "hunter2", light=True)
+        with pytest.raises(KeyStoreError):
+            decrypt_key(kj, "wrong")
+
+    def test_keystore_lifecycle(self, tmp_path):
+        ks = KeyStore(str(tmp_path), light=True)
+        acct = ks.import_key(KEY, "pw")
+        assert acct.address == ADDR
+        assert len(ks.accounts()) == 1
+        # locked: signing fails
+        with pytest.raises(KeyStoreError):
+            ks.sign_hash(ADDR, b"\x01" * 32)
+        ks.unlock(ADDR, "pw")
+        sig = ks.sign_hash(ADDR, keccak256(b"msg"))
+        assert len(sig) == 65
+        ks.lock_account(ADDR)
+        with pytest.raises(KeyStoreError):
+            ks.sign_hash(ADDR, b"\x01" * 32)
+
+    def test_sign_tx(self, tmp_path):
+        ks = KeyStore(str(tmp_path), light=True)
+        ks.import_key(KEY, "pw")
+        ks.unlock(ADDR, "pw")
+        tx = Transaction(type=2, chain_id=43112, nonce=0, max_fee=10**10,
+                         gas=21000, to=DEST, value=5)
+        signed = ks.sign_tx(ADDR, tx, 43112)
+        assert Signer(43112).sender(signed) == ADDR
+
+    def test_geth_vector(self):
+        """Web3 secret storage official pbkdf2 test vector."""
+        kj = {
+            "crypto": {
+                "cipher": "aes-128-ctr",
+                "cipherparams": {"iv": "6087dab2f9fdbbfaddc31a909735c1e6"},
+                "ciphertext": "5318b4d5bcd28de64ee5559e671353e16f075ecae9f99c7a79a38af5f869aa46",
+                "kdf": "pbkdf2",
+                "kdfparams": {
+                    "c": 262144, "dklen": 32, "prf": "hmac-sha256",
+                    "salt": "ae3cd4e7013836a3df6bd7241b12db061dbe2c6785853cce422d148a624ce0bd",
+                },
+                "mac": "517ead924a9d0dc3124507e3393d175ce3ff7c1e96529c6c555ce9e51205e9b2",
+            },
+            "id": "3198bc9c-6672-5ab3-d995-4942343ae5b6",
+            "version": 3,
+        }
+        priv = decrypt_key(kj, "testpassword")
+        assert priv.hex() == (
+            "7a28b5ba57c53603b0b07b56bba752f7784bf506fa95edc395f5cf6c7514fe9d"
+        )
+
+
+class TestABI:
+    def test_simple_pack(self):
+        # transfer(address,uint256)
+        abi = ABI([{
+            "type": "function", "name": "transfer",
+            "inputs": [{"name": "to", "type": "address"},
+                       {"name": "amount", "type": "uint256"}],
+            "outputs": [{"name": "", "type": "bool"}],
+        }])
+        data = abi.pack("transfer", DEST, 1000)
+        assert data[:4] == keccak256(b"transfer(address,uint256)")[:4]
+        assert data[4:36] == DEST.rjust(32, b"\x00")
+        assert int.from_bytes(data[36:68], "big") == 1000
+
+    def test_dynamic_types(self):
+        types = [parse_type("string"), parse_type("uint256"), parse_type("bytes")]
+        enc = pack_values(types, ["hello", 42, b"\xde\xad"])
+        out = unpack_values(types, enc)
+        assert out == ["hello", 42, b"\xde\xad"]
+
+    def test_arrays_and_tuples(self):
+        types = [
+            parse_type("uint256[]"),
+            parse_type("uint8[3]"),
+            parse_type("tuple", [{"name": "a", "type": "address"},
+                                 {"name": "b", "type": "uint256"}]),
+        ]
+        enc = pack_values(types, [[1, 2, 3], [7, 8, 9], (DEST, 55)])
+        out = unpack_values(types, enc)
+        assert out[0] == [1, 2, 3]
+        assert out[1] == [7, 8, 9]
+        assert out[2] == (DEST, 55)
+
+    def test_negative_int(self):
+        types = [parse_type("int256")]
+        enc = pack_values(types, [-12345])
+        assert unpack_values(types, enc) == [-12345]
+
+    def test_known_selector(self):
+        # the canonical ERC-20 balanceOf selector
+        abi = ABI([{
+            "type": "function", "name": "balanceOf",
+            "inputs": [{"name": "owner", "type": "address"}],
+            "outputs": [{"name": "", "type": "uint256"}],
+        }])
+        assert abi.methods["balanceOf"].selector().hex() == "70a08231"
+
+    def test_event_decode(self):
+        # Transfer(address indexed from, address indexed to, uint256 value)
+        abi = ABI([{
+            "type": "event", "name": "Transfer",
+            "inputs": [
+                {"name": "from", "type": "address", "indexed": True},
+                {"name": "to", "type": "address", "indexed": True},
+                {"name": "value", "type": "uint256", "indexed": False},
+            ],
+        }])
+        e = abi.events["Transfer"]
+        assert e.topic().hex() == (
+            "ddf252ad1be2c89b69c2b068fc378daa952ba7f163c4a11628f55a4df523b3ef"
+        )
+        topics = [e.topic(), ADDR.rjust(32, b"\x00"), DEST.rjust(32, b"\x00")]
+        data = (777).to_bytes(32, "big")
+        decoded = abi.decode_log("Transfer", topics, data)
+        assert decoded == {"from": ADDR, "to": DEST, "value": 777}
+
+    def test_range_check(self):
+        with pytest.raises(ABIError):
+            pack_values([parse_type("uint8")], [256])
+
+
+class TestEthClient:
+    def test_client_against_live_vm(self):
+        from coreth_tpu.ethclient import Client
+        from coreth_tpu.vm.api import create_handlers
+        from coreth_tpu.vm.shared_memory import Memory
+        from coreth_tpu.vm.vm import SnowContext, VM, VMConfig
+
+        vm = VM()
+        genesis = Genesis(
+            config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+            alloc={ADDR: GenesisAccount(balance=10**24)},
+        )
+        vm.initialize(SnowContext(shared_memory=Memory()), MemoryDB(), genesis,
+                      VMConfig(clock=lambda: vm.blockchain.current_block.time + 2))
+        server = create_handlers(vm)
+        client = Client(server=server)
+        assert client.chain_id() == 43112
+        tx = Signer(43112).sign(
+            Transaction(type=2, chain_id=43112, nonce=0, max_fee=10**12,
+                        max_priority_fee=10**9, gas=21000, to=DEST, value=99),
+            KEY,
+        )
+        h = client.send_transaction(tx)
+        blk = vm.build_block()
+        blk.verify()
+        blk.accept()
+        vm.blockchain.drain_acceptor_queue()
+        assert client.block_number() == 1
+        assert client.balance_at(DEST) == 99
+        receipt = client.transaction_receipt(h)
+        assert int(receipt["status"], 16) == 1
+        assert client.estimate_gas(
+            {"from": "0x" + ADDR.hex(), "to": "0x" + DEST.hex(), "value": "0x1"}
+        ) == 21000
+        vm.shutdown()
+
+
+class TestGossip:
+    def test_tx_gossip_between_vms(self):
+        from coreth_tpu.peer.network import Network
+        from coreth_tpu.vm.gossiper import Gossiper
+        from coreth_tpu.vm.shared_memory import Memory
+        from coreth_tpu.vm.vm import SnowContext, VM, VMConfig
+
+        def make(name):
+            vm = VM()
+            genesis = Genesis(
+                config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+                alloc={ADDR: GenesisAccount(balance=10**24)},
+            )
+            vm.initialize(SnowContext(shared_memory=Memory()), MemoryDB(), genesis,
+                          VMConfig())
+            net = Network(self_id=name)
+            return vm, net, Gossiper(vm, net)
+
+        vm1, net1, g1 = make(b"vm1")
+        vm2, net2, g2 = make(b"vm2")
+        # wire both directions
+        net1.connect(b"vm2", net2.app_request)
+        net2.connect(b"vm1", net1.app_request)
+
+        tx = Signer(43112).sign(
+            Transaction(type=2, chain_id=43112, nonce=0, max_fee=10**12,
+                        max_priority_fee=10**9, gas=21000, to=DEST, value=1),
+            KEY,
+        )
+        vm1.issue_tx(tx)  # pool feed → gossip → vm2's pool
+        assert vm2.txpool.has(tx.hash())
+        # no echo loop: vm1 still has exactly one
+        assert vm1.txpool.has(tx.hash())
+        vm1.shutdown()
+        vm2.shutdown()
+
+
+class TestMetrics:
+    def test_registry_and_export(self):
+        from coreth_tpu.metrics import Registry
+
+        r = Registry()
+        r.counter("chain/blocks").inc(5)
+        r.gauge("chain/height").update(42)
+        with r.timer("chain/insert").time():
+            pass
+        r.meter("chain/txs").mark(100)
+        out = r.export_prometheus()
+        assert "chain_blocks 5" in out
+        assert "chain_height 42" in out
+        assert "chain_txs_total 100" in out
+        assert "chain_insert_count 1" in out
+
+    def test_block_path_instrumented(self):
+        from coreth_tpu.metrics import default_registry
+
+        before = default_registry.timer("chain/block/inserts").count()
+        # run one insert through a tiny chain
+        from coreth_tpu.consensus.dummy import new_dummy_engine
+        from coreth_tpu.core.blockchain import BlockChain, CacheConfig
+        from coreth_tpu.core.chain_makers import generate_chain
+        from coreth_tpu.state.database import Database
+        from coreth_tpu.trie.triedb import TrieDatabase
+
+        db = MemoryDB()
+        genesis = Genesis(
+            config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+            alloc={ADDR: GenesisAccount(balance=10**24)},
+        )
+        chain = BlockChain(db, CacheConfig(), params.TEST_CHAIN_CONFIG, genesis,
+                           new_dummy_engine(), state_database=Database(TrieDatabase(db)))
+        blocks, _ = generate_chain(
+            chain.config, chain.genesis_block, chain.engine,
+            chain.state_database, 1,
+            gen=lambda i, bg: bg.add_tx(Signer(43112).sign(
+                Transaction(type=2, chain_id=43112, nonce=0, max_fee=10**12,
+                            max_priority_fee=10**9, gas=21000, to=DEST, value=1),
+                KEY)),
+        )
+        chain.insert_block(blocks[0])
+        assert default_registry.timer("chain/block/inserts").count() == before + 1
+        chain.stop()
