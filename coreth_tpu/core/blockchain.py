@@ -330,10 +330,15 @@ class BlockChain:
         self.validator.validate_body(block)
 
         statedb = self.state_at(parent.root)
+        # warm touched trie paths while txs execute (blockchain.go:1312)
+        statedb.start_prefetcher("chain")
 
-        with insert_timer.time():
-            receipts, logs, used_gas = self.processor.process(block, parent, statedb)
-            self.validator.validate_state(block, statedb, receipts, used_gas)
+        try:
+            with insert_timer.time():
+                receipts, logs, used_gas = self.processor.process(block, parent, statedb)
+                self.validator.validate_state(block, statedb, receipts, used_gas)
+        finally:
+            statedb.stop_prefetcher()
 
         if not writes:
             return

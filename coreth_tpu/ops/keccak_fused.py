@@ -1,0 +1,344 @@
+"""Fused multi-level trie commitment on device.
+
+The level-synchronized hasher's naive form round-trips host↔device once
+per trie level per size bucket (~20 dispatches per commit) — fatal when
+device latency is high. The fused design exploits a structural fact of
+MPT hashing: a parent's RLP *length* never depends on its children's
+digest *values* (a hashed-child reference is always 33 encoded bytes), so
+the host can precompute every node's keccak-padded message with zeroed
+digest slots plus a patch table (parent lane, byte offset, child lane),
+and the device runs the whole dependency chain itself:
+
+    for each (level, bucket) segment:          # unrolled at trace time
+        scatter child digests into the segment's messages
+        keccak the segment
+        append digests to the global digest array
+
+ONE host→device transfer, ONE dispatch, ONE digest readback. Device work
+is pure VPU-friendly u32 bit-ops; the sequential depth is the trie depth
+(~log16 N), with full batch parallelism inside each level.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .keccak_jax import RATE, WORDS_PER_BLOCK
+from .keccak_ref import _ROUND_CONSTANTS, _ROTC
+
+_RC_LO = np.array([rc & 0xFFFFFFFF for rc in _ROUND_CONSTANTS], dtype=np.uint32)
+_RC_HI = np.array([rc >> 32 for rc in _ROUND_CONSTANTS], dtype=np.uint32)
+
+
+def _rotl_pair(lo, hi, n: int):
+    n %= 64
+    if n == 0:
+        return lo, hi
+    if n == 32:
+        return hi, lo
+    if n > 32:
+        lo, hi = hi, lo
+        n -= 32
+    m = 32 - n
+    return (lo << n) | (hi >> m), (hi << n) | (lo >> m)
+
+
+def _keccak_f1600_scanned(lo, hi):
+    """24 rounds via lax.scan — tiny trace (one round body), same math as
+    keccak_jax.keccak_f1600. lo/hi: uint32[25, P]."""
+
+    def round_fn(state, rc):
+        lo, hi = state
+        rc_lo, rc_hi = rc
+        c_lo = [lo[x] ^ lo[x + 5] ^ lo[x + 10] ^ lo[x + 15] ^ lo[x + 20] for x in range(5)]
+        c_hi = [hi[x] ^ hi[x + 5] ^ hi[x + 10] ^ hi[x + 15] ^ hi[x + 20] for x in range(5)]
+        d_lo, d_hi = [], []
+        for x in range(5):
+            rl, rh = _rotl_pair(c_lo[(x + 1) % 5], c_hi[(x + 1) % 5], 1)
+            d_lo.append(c_lo[(x - 1) % 5] ^ rl)
+            d_hi.append(c_hi[(x - 1) % 5] ^ rh)
+        lo2 = [lo[i] ^ d_lo[i % 5] for i in range(25)]
+        hi2 = [hi[i] ^ d_hi[i % 5] for i in range(25)]
+        b_lo = [None] * 25
+        b_hi = [None] * 25
+        for x in range(5):
+            for y in range(5):
+                src = x + 5 * y
+                dst = y + 5 * ((2 * x + 3 * y) % 5)
+                b_lo[dst], b_hi[dst] = _rotl_pair(lo2[src], hi2[src], _ROTC[src])
+        lo3 = [
+            b_lo[i] ^ (~b_lo[(i % 5 + 1) % 5 + 5 * (i // 5)] & b_lo[(i % 5 + 2) % 5 + 5 * (i // 5)])
+            for i in range(25)
+        ]
+        hi3 = [
+            b_hi[i] ^ (~b_hi[(i % 5 + 1) % 5 + 5 * (i // 5)] & b_hi[(i % 5 + 2) % 5 + 5 * (i // 5)])
+            for i in range(25)
+        ]
+        lo3[0] = lo3[0] ^ rc_lo
+        hi3[0] = hi3[0] ^ rc_hi
+        return (jnp.stack(lo3), jnp.stack(hi3)), None
+
+    lo_s = lo if isinstance(lo, jnp.ndarray) else jnp.stack(lo)
+    hi_s = hi if isinstance(hi, jnp.ndarray) else jnp.stack(hi)
+
+    def body(state, rc):
+        (l, h) = state
+        return round_fn((list(l), list(h)), rc)
+
+    (lo_s, hi_s), _ = jax.lax.scan(
+        body, (lo_s, hi_s), (jnp.asarray(_RC_LO), jnp.asarray(_RC_HI))
+    )
+    return lo_s, hi_s
+
+
+class SegmentSpec(NamedTuple):
+    """Static shape descriptor for one (level, bucket) group."""
+
+    blocks: int        # rate blocks per lane in this segment
+    lanes: int         # padded lane count
+    gstart: int        # start offset in the global digest array
+    n_patches: int     # padded patch count
+
+
+def _u8_to_words(a_u8: jnp.ndarray, blocks: int) -> jnp.ndarray:
+    """uint8[P, blocks*136] -> uint32[P, blocks, 34] (little-endian)."""
+    p = a_u8.shape[0]
+    b4 = a_u8.reshape(p, blocks, WORDS_PER_BLOCK, 4).astype(jnp.uint32)
+    return (
+        b4[..., 0]
+        | (b4[..., 1] << 8)
+        | (b4[..., 2] << 16)
+        | (b4[..., 3] << 24)
+    )
+
+
+def _words_to_u8(w: jnp.ndarray) -> jnp.ndarray:
+    """uint32[P, 8] digest words -> uint8[P, 32]."""
+    p = w.shape[0]
+    out = jnp.stack(
+        [(w >> (8 * i)) & 0xFF for i in range(4)], axis=-1
+    )  # [P, 8, 4]
+    return out.astype(jnp.uint8).reshape(p, 32)
+
+
+def _keccak_segment(words: jnp.ndarray, nblocks: jnp.ndarray) -> jnp.ndarray:
+    """uint32[P, L, 34] + int32[P] -> uint32[P, 8].
+
+    Double scan (blocks outer, rounds inner) keeps the traced program tiny
+    so ~20 segments can inline into one XLA module without minute-long
+    compiles."""
+    p = words.shape[0]
+    lo = jnp.zeros((25, p), jnp.uint32)
+    hi = jnp.zeros((25, p), jnp.uint32)
+    out = jnp.zeros((p, 8), jnp.uint32)
+    words_t = jnp.transpose(words, (1, 0, 2))  # [L, P, 34]
+    idx = jnp.arange(words.shape[1], dtype=jnp.int32)
+
+    def step(carry, xs):
+        lo, hi, out = carry
+        block, j = xs
+        live = (j < nblocks).astype(jnp.uint32)
+        absorb_lo = jnp.concatenate(
+            [jnp.transpose(block[:, 0:34:2]) * live, jnp.zeros((8, p), jnp.uint32)]
+        )
+        absorb_hi = jnp.concatenate(
+            [jnp.transpose(block[:, 1:34:2]) * live, jnp.zeros((8, p), jnp.uint32)]
+        )
+        lo = lo ^ absorb_lo
+        hi = hi ^ absorb_hi
+        lo, hi = _keccak_f1600_scanned(lo, hi)
+        digest = jnp.stack(
+            [lo[0], hi[0], lo[1], hi[1], lo[2], hi[2], lo[3], hi[3]], axis=1
+        )
+        is_last = (j == nblocks - 1)[:, None]
+        out = jnp.where(is_last, digest, out)
+        return (lo, hi, out), None
+
+    (lo, hi, out), _ = jax.lax.scan(step, (lo, hi, out), (words_t, idx))
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("specs",))
+def fused_commit(specs: Tuple[SegmentSpec, ...], flat_msgs: jax.Array,
+                 nblocks: jax.Array, patch_lane: jax.Array,
+                 patch_off: jax.Array, patch_child: jax.Array) -> jax.Array:
+    """Run the whole level-synchronized commit in one dispatch.
+
+    flat_msgs:  uint8[sum(lanes*blocks*136)]  segment messages, concatenated
+    nblocks:    int32[G]                      per-lane block counts
+    patch_*:    int32[sum(n_patches)]         per-segment patch tables
+    returns     uint8[G, 32] digests in global lane order
+    """
+    g = nblocks.shape[0]
+    dig8 = jnp.zeros((g, 32), jnp.uint8)
+    ar32 = jnp.arange(32)
+
+    msg_off = 0
+    patch_pos = 0
+    for spec in specs:
+        size = spec.lanes * spec.blocks * RATE
+        seg = jax.lax.dynamic_slice(flat_msgs, (msg_off,), (size,)).reshape(
+            spec.lanes, spec.blocks * RATE
+        )
+        msg_off += size
+        if spec.n_patches:
+            pl = jax.lax.dynamic_slice(patch_lane, (patch_pos,), (spec.n_patches,))
+            po = jax.lax.dynamic_slice(patch_off, (patch_pos,), (spec.n_patches,))
+            pc = jax.lax.dynamic_slice(patch_child, (patch_pos,), (spec.n_patches,))
+            patch_pos += spec.n_patches
+            vals = dig8[pc]  # [P, 32] gather from earlier levels
+            seg = seg.at[pl[:, None], po[:, None] + ar32[None, :]].set(vals)
+        words = _u8_to_words(seg, spec.blocks)
+        nb = jax.lax.dynamic_slice(nblocks, (spec.gstart,), (spec.lanes,))
+        out = _keccak_segment(words, nb)
+        dig8 = jax.lax.dynamic_update_slice(dig8, _words_to_u8(out), (spec.gstart, 0))
+    return dig8
+
+
+def _pow2_at_least(v: int, floor: int = 16) -> int:
+    t = floor
+    while t < v:
+        t *= 2
+    return t
+
+
+class FusedBatch:
+    """Host-side builder collecting levels of (padded message, patches).
+
+    add_level() takes the level's messages (keccak-padded bytes with zeroed
+    digest slots) and patches [(msg_idx_in_level, byte_off, child_gidx)];
+    returns the global indices assigned to the level's lanes. run() makes
+    one device call and returns all digests.
+    """
+
+    def __init__(self):
+        self.levels: List[dict] = []
+        self.total = 0
+
+    def add_level(self, padded_msgs: List[bytes], nblocks: List[int],
+                  patches: List[Tuple[int, int, int]]) -> List[int]:
+        gids = list(range(self.total, self.total + len(padded_msgs)))
+        self.levels.append({
+            "msgs": padded_msgs,
+            "nblocks": nblocks,
+            "patches": patches,
+            "gids": gids,
+        })
+        self.total += len(padded_msgs)
+        return gids
+
+    def run(self, impl=fused_commit) -> List[bytes]:
+        """Build segment arrays (bucketed by block count, padded to
+        power-of-two lane counts) and execute. Returns digests by gid.
+
+        Packing is vectorized: per segment, messages are joined once and
+        scattered with a single fancy-indexed assignment (no per-lane
+        Python loop), mirroring keccak_jax.pack_messages."""
+        specs: List[SegmentSpec] = []
+        seg_msgs: List[np.ndarray] = []
+        all_nblocks: List[np.ndarray] = []
+        all_pl: List[np.ndarray] = []
+        all_po: List[np.ndarray] = []
+        all_pc: List[np.ndarray] = []
+        remap = np.zeros(max(self.total, 1), dtype=np.int64)
+        gpos = 0
+
+        for level in self.levels:
+            msgs = level["msgs"]
+            if not msgs:
+                continue
+            nb = np.asarray(level["nblocks"], dtype=np.int32)
+            gid0 = level["gids"][0] if level["gids"] else 0
+            patches = level["patches"]  # (msg_idx, off, child_gid)
+
+            # bucket by power-of-two block count
+            keys = np.where(nb > 1, 1 << (32 - ((nb - 1) >> 0).astype(np.uint32).byteswap().view(np.uint8).reshape(-1, 4)[:, 0]), 1) if False else None
+            keys = np.asarray([1 << int(b - 1).bit_length() if b > 1 else 1 for b in nb])
+            patch_msgs = {mi for mi, _, _ in patches}
+            for key in np.unique(keys):
+                (idxs,) = np.nonzero(keys == key)
+                has_patches = any(int(mi) in patch_msgs for mi in idxs)
+                lanes = _pow2_at_least(len(idxs) + (1 if has_patches else 0))
+                width = int(key) * RATE
+                arr = np.zeros((lanes, width), dtype=np.uint8)
+                # vectorized scatter of all bucket messages at once
+                lengths = np.asarray([len(msgs[int(mi)]) for mi in idxs], dtype=np.int64)
+                if len(idxs):
+                    src = np.frombuffer(b"".join(msgs[int(mi)] for mi in idxs), dtype=np.uint8)
+                    starts = np.zeros(len(idxs), dtype=np.int64)
+                    np.cumsum(lengths[:-1], out=starts[1:])
+                    within = np.arange(int(lengths.sum()), dtype=np.int64) - np.repeat(starts, lengths)
+                    dest = np.repeat(np.arange(len(idxs), dtype=np.int64) * width, lengths) + within
+                    arr.reshape(-1)[dest] = src
+                seg_nb = np.ones(lanes, dtype=np.int32)
+                seg_nb[: len(idxs)] = nb[idxs]
+                remap[np.asarray(level["gids"], dtype=np.int64)[idxs]] = (
+                    gpos + np.arange(len(idxs), dtype=np.int64)
+                )
+                # per-bucket patch tables (msg_idx -> bucket lane)
+                lane_of = {int(mi): lane for lane, mi in enumerate(idxs)}
+                pl, po, pc = [], [], []
+                for mi, off, child in patches:
+                    lane = lane_of.get(mi)
+                    if lane is not None:
+                        pl.append(lane)
+                        po.append(off)
+                        pc.append(child)
+                scratch = lanes - 1
+                n_patches = _pow2_at_least(len(pl), 16) if pl else 0
+                for _ in range(n_patches - len(pl)):
+                    pl.append(scratch)
+                    po.append(0)
+                    pc.append(-1)
+                specs.append(SegmentSpec(int(key), lanes, gpos, n_patches))
+                seg_msgs.append(arr)
+                all_nblocks.append(seg_nb)
+                all_pl.append(np.asarray(pl, dtype=np.int32))
+                all_po.append(np.asarray(po, dtype=np.int32))
+                all_pc.append(np.asarray(pc, dtype=np.int64))
+                gpos += lanes
+
+        # child gids -> packed positions (vectorized; pads (-1) -> lane 0,
+        # harmless: their write lands in the scratch lane)
+        flat_pc = [
+            np.where(pc >= 0, remap[np.maximum(pc, 0)], 0).astype(np.int32)
+            for pc in all_pc
+        ]
+
+        flat_msgs = (
+            np.concatenate([a.reshape(-1) for a in seg_msgs])
+            if seg_msgs
+            else np.zeros(0, dtype=np.uint8)
+        )
+        nblocks = (
+            np.concatenate(all_nblocks) if all_nblocks else np.zeros(0, np.int32)
+        )
+        patch_lane = (
+            np.concatenate(all_pl) if all_pl else np.zeros(0, np.int32)
+        )
+        patch_off = (
+            np.concatenate(all_po) if all_po else np.zeros(0, np.int32)
+        )
+        patch_child = (
+            np.concatenate(flat_pc) if flat_pc else np.zeros(0, np.int32)
+        )
+
+        dig8 = np.asarray(
+            impl(
+                tuple(specs),
+                jnp.asarray(flat_msgs),
+                jnp.asarray(nblocks),
+                jnp.asarray(patch_lane),
+                jnp.asarray(patch_off),
+                jnp.asarray(patch_child),
+            )
+        )
+        # one gather puts digests back into gid order; slice lazily
+        ordered = dig8[remap[: self.total]]
+        raw = ordered.tobytes()
+        return [raw[i * 32 : i * 32 + 32] for i in range(self.total)]

@@ -65,6 +65,9 @@ class StateDB:
         self.access_list = AccessList()
         self.transient: Dict[Tuple[bytes, bytes], bytes] = {}
 
+        # concurrent trie warmer (core/state/trie_prefetcher.go seam)
+        self.prefetcher = None
+
         # flat snapshot tree (Phase 4); when set, reads go through it first
         self.snaps = snaps
         self.snap = snaps.snapshot(root) if snaps is not None else None
@@ -89,9 +92,23 @@ class StateDB:
             return obj
         return self._load_state_object(addr)
 
+    def start_prefetcher(self, namespace: str = "chain") -> None:
+        """StartPrefetcher (statedb.go): warm touched tries concurrently."""
+        from .trie_prefetcher import TriePrefetcher
+
+        self.stop_prefetcher()
+        self.prefetcher = TriePrefetcher(self.db, namespace)
+
+    def stop_prefetcher(self) -> None:
+        if self.prefetcher is not None:
+            self.prefetcher.close()
+            self.prefetcher = None
+
     def _load_state_object(self, addr: bytes) -> Optional[StateObject]:
         acct = None
         addr_hash = keccak256(addr)
+        if self.prefetcher is not None:
+            self.prefetcher.prefetch(b"", self.original_root, [addr])
         if self.snap is not None:
             try:
                 slim = self.snap.account(addr_hash)

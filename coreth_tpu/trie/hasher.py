@@ -198,3 +198,239 @@ def new_hasher(dirty_estimate: int = 0, batch_keccak=None):
     if batch_keccak is not None and dirty_estimate >= BATCH_THRESHOLD:
         return BatchedHasher(batch_keccak)
     return Hasher()
+
+
+# ---------------------------------------------------------------------------
+# Fused hasher: the whole commit in ONE device dispatch
+# ---------------------------------------------------------------------------
+
+
+class _Slot:
+    """Placeholder for a not-yet-computed child digest in a parent's RLP."""
+
+    __slots__ = ("gid",)
+
+    def __init__(self, gid: int):
+        self.gid = gid
+
+
+def _item_len(item) -> int:
+    """Encoded RLP length; Slot counts as a 32-byte string (33 encoded)."""
+    if isinstance(item, _Slot):
+        return 33
+    if isinstance(item, (bytes, bytearray)):
+        n = len(item)
+        if n == 1 and item[0] < 0x80:
+            return 1
+        if n < 56:
+            return 1 + n
+        ll = (n.bit_length() + 7) // 8
+        return 1 + ll + n
+    if isinstance(item, list):
+        payload = sum(_item_len(i) for i in item)
+        if payload < 56:
+            return 1 + payload
+        ll = (payload.bit_length() + 7) // 8
+        return 1 + ll + payload
+    raise TypeError(f"cannot size {type(item)}")
+
+
+def _write_item(item, out: bytearray, patches: list) -> None:
+    """Serialize with zeroed digest slots, recording (offset, gid) patches."""
+    if isinstance(item, _Slot):
+        out.append(0xA0)
+        patches.append((len(out), item.gid))
+        out.extend(b"\x00" * 32)
+        return
+    if isinstance(item, (bytes, bytearray)):
+        n = len(item)
+        if n == 1 and item[0] < 0x80:
+            out.append(item[0])
+        elif n < 56:
+            out.append(0x80 + n)
+            out.extend(item)
+        else:
+            lb = n.to_bytes((n.bit_length() + 7) // 8, "big")
+            out.append(0xB7 + len(lb))
+            out.extend(lb)
+            out.extend(item)
+        return
+    if isinstance(item, list):
+        payload = sum(_item_len(i) for i in item)
+        if payload < 56:
+            out.append(0xC0 + payload)
+        else:
+            lb = payload.to_bytes((payload.bit_length() + 7) // 8, "big")
+            out.append(0xF7 + len(lb))
+            out.extend(lb)
+        for i in item:
+            _write_item(i, out, patches)
+        return
+    raise TypeError(f"cannot write {type(item)}")
+
+
+_KECCAK_RATE = 136
+
+
+def _keccak_pad(msg: bytes) -> Tuple[bytes, int]:
+    """Keccak-256 pad10*1; returns (padded bytes, block count)."""
+    n = len(msg)
+    blocks = n // _KECCAK_RATE + 1
+    padded = bytearray(blocks * _KECCAK_RATE)
+    padded[:n] = msg
+    padded[n] ^= 0x01
+    padded[-1] ^= 0x80
+    return bytes(padded), blocks
+
+
+class FusedHasher:
+    """One-dispatch commit hashing (ops/keccak_fused.py consumer).
+
+    The entire dirty set — every level, every size bucket — ships to the
+    device as one transfer; child digests are patched into parent messages
+    on-device between levels. Bit-exact with Hasher/BatchedHasher.
+
+    The builder is a single-pass writer: each node's encoded length is
+    cached when it is processed, so parents compute their RLP headers
+    arithmetically and write their body exactly once (no separate
+    node_items/_item_len/_write_item traversals).
+    """
+
+    def __init__(self, fused_impl=None):
+        from ..ops.keccak_fused import FusedBatch, fused_commit
+
+        self._FusedBatch = FusedBatch
+        self._impl = fused_impl if fused_impl is not None else fused_commit
+
+    def hash_root(self, root) -> HashNode:
+        if not isinstance(root, (ShortNode, FullNode)):
+            raise TypeError("fused hasher needs a Short/Full root")
+        levels = BatchedHasher._collect_levels(root)
+        batch = self._FusedBatch()
+
+        # per-node info: (kind, payload) where kind is one of
+        #   "gid"   — hashed; payload = global digest index (33 enc bytes)
+        #   "embed" — embedded; payload = raw encoded bytes (with no slots)
+        info: dict = {}
+        hashed_nodes: list = []
+
+        def child_len(c) -> int:
+            """Encoded length of a child reference."""
+            if c is None:
+                return 1
+            if isinstance(c, (HashNode, ValueNode)):
+                return _bytes_enc_len(bytes(c))
+            if c.flags.hash is not None:
+                return 33
+            kind, payload = info[id(c)]
+            return 33 if kind == "gid" else len(payload)
+
+        def write_child(c, out: bytearray, patches: list) -> None:
+            if c is None:
+                out.append(0x80)
+                return
+            if isinstance(c, (HashNode, ValueNode)):
+                _write_bytes(bytes(c), out)
+                return
+            if c.flags.hash is not None:
+                _write_bytes(c.flags.hash, out)
+                return
+            kind, payload = info[id(c)]
+            if kind == "gid":
+                out.append(0xA0)
+                patches.append((len(out), payload))
+                out.extend(b"\x00" * 32)
+            else:
+                out.extend(payload)
+
+        for level in levels:
+            msgs, nblocks, patches, nodes_here = [], [], [], []
+            for n in level:
+                # payload length from cached child lengths
+                if isinstance(n, ShortNode):
+                    key_enc = hex_to_compact(n.key)
+                    payload_len = _bytes_enc_len(key_enc) + child_len(n.val)
+                else:
+                    payload_len = 0
+                    for i in range(16):
+                        payload_len += child_len(n.children[i])
+                    v = n.children[16]
+                    payload_len += (
+                        _bytes_enc_len(bytes(v)) if isinstance(v, ValueNode) else 1
+                    )
+                total_len = _list_hdr_len(payload_len) + payload_len
+
+                buf = bytearray()
+                node_patches: list = []
+                _write_list_hdr(payload_len, buf)
+                if isinstance(n, ShortNode):
+                    _write_bytes(key_enc, buf)
+                    write_child(n.val, buf, node_patches)
+                else:
+                    for i in range(16):
+                        write_child(n.children[i], buf, node_patches)
+                    v = n.children[16]
+                    if isinstance(v, ValueNode):
+                        _write_bytes(bytes(v), buf)
+                    else:
+                        buf.append(0x80)
+
+                if total_len < 32 and n is not root:
+                    info[id(n)] = ("embed", bytes(buf))
+                    continue
+                padded, blocks = _keccak_pad(bytes(buf))
+                mi = len(msgs)
+                msgs.append(padded)
+                nblocks.append(blocks)
+                # patch offsets recorded during this node's write
+                for off, gid in node_patches:
+                    patches.append((mi, off, gid))
+                nodes_here.append(n)
+            level_gids = batch.add_level(msgs, nblocks, patches)
+            for n, g in zip(nodes_here, level_gids):
+                info[id(n)] = ("gid", g)
+                hashed_nodes.append((n, g))
+
+        digests = batch.run(self._impl)
+        for n, g in hashed_nodes:
+            n.flags.hash = digests[g]
+            n.flags.dirty = True
+        return HashNode(root.flags.hash)
+
+
+def _bytes_enc_len(b: bytes) -> int:
+    n = len(b)
+    if n == 1 and b[0] < 0x80:
+        return 1
+    if n < 56:
+        return 1 + n
+    return 1 + (n.bit_length() + 7) // 8 + n
+
+
+def _write_bytes(b: bytes, out: bytearray) -> None:
+    n = len(b)
+    if n == 1 and b[0] < 0x80:
+        out.append(b[0])
+    elif n < 56:
+        out.append(0x80 + n)
+        out.extend(b)
+    else:
+        lb = n.to_bytes((n.bit_length() + 7) // 8, "big")
+        out.append(0xB7 + len(lb))
+        out.extend(lb)
+        out.extend(b)
+
+
+def _list_hdr_len(payload: int) -> int:
+    if payload < 56:
+        return 1
+    return 1 + (payload.bit_length() + 7) // 8
+
+
+def _write_list_hdr(payload: int, out: bytearray) -> None:
+    if payload < 56:
+        out.append(0xC0 + payload)
+    else:
+        lb = payload.to_bytes((payload.bit_length() + 7) // 8, "big")
+        out.append(0xF7 + len(lb))
+        out.extend(lb)

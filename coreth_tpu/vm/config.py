@@ -1,0 +1,133 @@
+"""VM configuration (role of /root/reference/plugin/evm/config.go).
+
+The node hands the VM a JSON blob at Initialize (vm.go:327); it decodes
+into Config with SetDefaults/Validate. The knob set mirrors config.go
+:80-193 — caches, pruning, tx pool, gossip, state sync, profiling, API
+gating.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, fields
+from typing import List, Optional
+
+DEFAULT_ETH_APIS = [
+    "eth", "eth-filter", "net", "web3", "internal-eth", "internal-blockchain",
+    "internal-transaction",
+]
+
+
+@dataclass
+class Config:
+    # --- API gating (config.go eth-apis) ---------------------------------
+    eth_apis: List[str] = field(default_factory=lambda: list(DEFAULT_ETH_APIS))
+    admin_api_enabled: bool = False
+    health_api_enabled: bool = True
+    coreth_admin_api_enabled: bool = False
+    ws_cpu_refill_rate: int = 0
+    ws_cpu_max_stored: int = 0
+    api_max_duration: float = 0.0
+    api_max_blocks_per_request: int = 0
+    allow_unfinalized_queries: bool = False
+    allow_unprotected_txs: bool = False
+
+    # --- caches ----------------------------------------------------------
+    trie_clean_cache: int = 512        # MB
+    trie_dirty_cache: int = 256        # MB
+    trie_dirty_commit_target: int = 20  # MB
+    snapshot_cache: int = 256          # MB
+    accepted_cache_size: int = 32
+
+    # --- eth settings -----------------------------------------------------
+    preimages_enabled: bool = False
+    snapshot_async: bool = True
+    snapshot_verification_enabled: bool = False
+
+    # --- pruning ----------------------------------------------------------
+    pruning_enabled: bool = True
+    commit_interval: int = 4096
+    accepted_queue_limit: int = 64
+    allow_missing_tries: bool = False
+    populate_missing_tries: Optional[int] = None
+    populate_missing_tries_parallelism: int = 1024
+    offline_pruning_enabled: bool = False
+    offline_pruning_bloom_filter_size: int = 512   # MB
+    offline_pruning_data_directory: str = ""
+
+    # --- tx pool ----------------------------------------------------------
+    local_txs_enabled: bool = False
+    tx_pool_price_limit: int = 1
+    tx_pool_price_bump: int = 10
+    tx_pool_account_slots: int = 16
+    tx_pool_global_slots: int = 4096
+    tx_pool_account_queue: int = 64
+    tx_pool_global_queue: int = 1024
+
+    # --- gossip -----------------------------------------------------------
+    remote_gossip_only_enabled: bool = False
+    regossip_frequency: float = 60.0
+    regossip_max_txs: int = 16
+    regossip_tx_queue_size: int = 64
+
+    # --- logging / profiling ---------------------------------------------
+    log_level: str = "info"
+    log_json_format: bool = False
+    continuous_profiler_dir: str = ""
+    continuous_profiler_frequency: float = 900.0
+    continuous_profiler_max_files: int = 5
+
+    # --- metrics ----------------------------------------------------------
+    metrics_expensive_enabled: bool = False
+
+    # --- keystore ---------------------------------------------------------
+    keystore_directory: str = ""
+    keystore_external_signer: str = ""
+    keystore_insecure_unlock_allowed: bool = False
+
+    # --- state sync -------------------------------------------------------
+    state_sync_enabled: bool = False
+    state_sync_skip_resume: bool = False
+    state_sync_server_trie_cache: int = 64  # MB
+    state_sync_ids: str = ""
+    state_sync_commit_interval: int = 16384
+    state_sync_min_blocks: int = 300_000
+
+    # --- misc -------------------------------------------------------------
+    max_outbound_active_requests: int = 16
+    max_outbound_active_cross_chain_requests: int = 64
+
+    def validate(self) -> None:
+        """config.go Validate."""
+        if self.populate_missing_tries is not None and (
+            self.offline_pruning_enabled or self.pruning_enabled
+        ):
+            raise ValueError(
+                "cannot enable populate-missing-tries while pruning (must be archival)"
+            )
+        if self.offline_pruning_enabled and not self.pruning_enabled:
+            raise ValueError("cannot run offline pruning while pruning is disabled")
+        if self.commit_interval == 0 and self.pruning_enabled:
+            raise ValueError("commit interval must be non-zero in pruning mode")
+        if self.state_sync_commit_interval % self.commit_interval != 0:
+            raise ValueError(
+                f"state sync commit interval ({self.state_sync_commit_interval}) "
+                f"must be a multiple of commit interval ({self.commit_interval})"
+            )
+
+
+def parse_config(config_bytes: bytes) -> Config:
+    """Decode the Initialize JSON blob, applying defaults for absent keys
+    (vm.go:326-334). JSON keys are the reference's kebab-case names."""
+    cfg = Config()
+    if not config_bytes:
+        return cfg
+    raw = json.loads(config_bytes)
+    key_map = {f.name.replace("_", "-"): f.name for f in fields(Config)}
+    for k, v in raw.items():
+        attr = key_map.get(k)
+        if attr is None:
+            continue  # unknown keys are ignored like the reference
+        setattr(cfg, attr, v)
+    cfg.validate()
+    return cfg

@@ -28,9 +28,11 @@ SYNC_SUMMARY_KEY = b"stateSyncSummary"
 class StateSyncServer:
     """GetLastStateSummary/GetStateSummaryByHeight (syncervm_server.go)."""
 
-    def __init__(self, chain, syncable_interval: int = SYNCABLE_INTERVAL):
+    def __init__(self, chain, syncable_interval: int = SYNCABLE_INTERVAL,
+                 vm=None):
         self.chain = chain
         self.syncable_interval = syncable_interval
+        self.vm = vm
 
     def get_last_state_summary(self) -> Optional[SyncSummary]:
         h = self.chain.last_accepted.number
@@ -43,7 +45,10 @@ class StateSyncServer:
         blk = self.chain.get_block_by_number(height)
         if blk is None or not self.chain.has_state(blk.root):
             return None
-        return SyncSummary(blk.number, blk.hash(), blk.root)
+        atomic_root = b"\x00" * 32
+        if self.vm is not None and getattr(self.vm, "atomic_trie", None) is not None:
+            atomic_root, _ = self.vm.atomic_trie.root_at()
+        return SyncSummary(blk.number, blk.hash(), blk.root, atomic_root)
 
 
 class StateSyncClient:
@@ -70,7 +75,26 @@ class StateSyncClient:
     def state_sync(self, summary: SyncSummary) -> None:
         self._sync_blocks(summary)
         self._sync_state_trie(summary)
+        self._sync_atomic_trie(summary)
         self._finish(summary)
+
+    def _sync_atomic_trie(self, summary: SyncSummary) -> None:
+        """syncAtomicTrie (:284): rebuild the indexed atomic ops and replay
+        them into this node's shared memory."""
+        from ..trie.node import EMPTY_ROOT
+        from .atomic_trie import AtomicSyncer
+
+        if summary.atomic_root in (b"\x00" * 32, EMPTY_ROOT):
+            return
+        syncer = AtomicSyncer(
+            self.client, self.vm.blockchain.diskdb,
+            summary.atomic_root, summary.block_number,
+        )
+        syncer.sync()
+        self.vm.atomic_trie = syncer.trie
+        syncer.trie.apply_to_shared_memory(
+            self.vm.shared_memory, summary.block_number
+        )
 
     def _sync_blocks(self, summary: SyncSummary) -> None:
         """syncBlocks (:237): fetch 256 parents so the chain can verify

@@ -79,8 +79,24 @@ class VM:
         genesis: Genesis,
         config: VMConfig = None,
         to_engine=None,
+        config_bytes: bytes = b"",
     ) -> None:
         self.ctx = ctx
+        if config is None and config_bytes:
+            # JSON blob from the node (vm.go:326-334) → runtime knobs
+            from .config import parse_config
+
+            full = parse_config(config_bytes)
+            self.full_config = full
+            config = VMConfig(
+                pruning=full.pruning_enabled,
+                commit_interval=full.commit_interval,
+                mempool_size=full.tx_pool_global_slots,
+            )
+        else:
+            from .config import Config as FullConfig
+
+            self.full_config = FullConfig()
         self.config = config or VMConfig()
         self.chain_config = genesis.config
         self.network_id = ctx.network_id
@@ -133,6 +149,11 @@ class VM:
         self.mempool = Mempool(
             self.config.mempool_size, fee_fn=price, max_tx_gas=fits_atomic_gas
         )
+
+        # atomic ops index with interval commits (atomic_trie.go)
+        from .atomic_trie import AtomicTrie
+
+        self.atomic_trie = AtomicTrie(diskdb, self.config.commit_interval)
 
         self._verified_blocks: Dict[bytes, VMBlock] = {}
         self._accepted_atomic_ops: List = []
@@ -315,6 +336,7 @@ class VM:
         )
         self.shared_memory.apply({chain: requests}, batch=batch)
         self.mempool.remove_tx(tx)
+        self.atomic_trie.index(vmb.height(), {chain: requests})
 
     # --- atomic tx issuance (vm.go:1297-1417) -----------------------------
 

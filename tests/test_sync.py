@@ -273,3 +273,123 @@ class TestTwoVMStateSync:
         assert client_vm.blockchain.state().get_balance(DEST) == 4 * 5 * 3 + 9
         client_vm.shutdown()
         server.shutdown()
+
+
+class TestAtomicTrie:
+    def test_index_commit_iterate(self):
+        from coreth_tpu.vm.atomic_trie import AtomicTrie
+        from coreth_tpu.vm.shared_memory import Element, Requests
+
+        db = MemoryDB()
+        at = AtomicTrie(db, commit_interval=4)
+        x_chain = b"\x58" * 32
+        for h in range(1, 5):
+            req = Requests(put_requests=[
+                Element(key=h.to_bytes(32, "big"), value=b"utxo%d" % h, traits=[ADDR])
+            ])
+            root = at.index(h, {x_chain: req})
+        assert root is not None  # committed at height 4
+        assert at.last_committed_height == 4
+        entries = list(at.iterate())
+        assert [h for h, _, _ in entries] == [1, 2, 3, 4]
+        assert entries[0][1] == x_chain
+
+    def test_reopen_restores_committed(self):
+        from coreth_tpu.vm.atomic_trie import AtomicTrie
+        from coreth_tpu.vm.shared_memory import Element, Requests
+
+        db = MemoryDB()
+        at = AtomicTrie(db, commit_interval=2)
+        req = Requests(put_requests=[Element(b"\x01" * 32, b"v", [ADDR])])
+        at.index(1, {b"\x58" * 32: req})
+        root = at.index(2, {b"\x58" * 32: req})
+        at2 = AtomicTrie(db, commit_interval=2)
+        assert at2.last_committed_root == root
+        assert at2.last_committed_height == 2
+        assert len(list(at2.iterate())) == 2
+
+    def test_atomic_trie_synced_between_vms(self):
+        """Server indexes an accepted export; the syncer VM rebuilds the
+        atomic trie from leaves and replays into its shared memory."""
+        from coreth_tpu.vm.atomic_tx import EVMInput, ExportTx, Tx, UTXO
+        from coreth_tpu.vm.syncervm import StateSyncClient, StateSyncServer
+
+        # server VM with commit_interval=4 and one export tx at height 1
+        mem = Memory()
+        server = VM()
+        genesis = Genesis(
+            config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+            alloc={ADDR: GenesisAccount(balance=FUND)},
+        )
+        clock = [0]
+
+        def tick():
+            clock[0] = server.blockchain.current_block.time + 2
+            return clock[0]
+
+        server.initialize(SnowContext(shared_memory=mem), MemoryDB(), genesis,
+                          VMConfig(clock=tick, commit_interval=4))
+        exp = ExportTx(
+            network_id=1337, blockchain_id=b"\x02" * 32,
+            destination_chain=b"\x58" * 32,
+            ins=[EVMInput(address=ADDR, amount=5 * 10**9, asset_id=b"\x41" * 32, nonce=0)],
+            exported_outputs=[UTXO(tx_id=b"\x00" * 32, output_index=0,
+                                   asset_id=b"\x41" * 32, amount=4 * 10**9,
+                                   address=b"\x99" * 20)],
+        )
+        atx = Tx(exp)
+        atx.sign([KEY])
+        server.issue_atomic_tx(atx)
+        blk = server.build_block()
+        blk.verify()
+        blk.accept()
+        # pad to the commit boundary with eth blocks
+        signer = Signer(43112)
+        for n in range(1, 4):
+            t = Transaction(type=2, chain_id=43112, nonce=n, max_fee=10**12,
+                            max_priority_fee=10**9, gas=21000, to=DEST, value=1)
+            server.issue_tx(signer.sign(t, KEY))
+            b = server.build_block()
+            b.verify()
+            b.accept()
+        server.blockchain.drain_acceptor_queue()
+        # force-commit the atomic trie at the summary height
+        server.atomic_trie.commit(4)
+
+        sync_server = StateSyncServer(server.blockchain, syncable_interval=4,
+                                      vm=server)
+        summary = sync_server.get_last_state_summary()
+        assert summary.atomic_root == server.atomic_trie.last_committed_root
+        assert summary.atomic_root != b"\x00" * 32
+
+        client_vm = VM()
+        client_vm.initialize(SnowContext(shared_memory=Memory()), MemoryDB(),
+                             Genesis(config=params.TEST_CHAIN_CONFIG,
+                                     gas_limit=params.CORTINA_GAS_LIMIT,
+                                     alloc={ADDR: GenesisAccount(balance=FUND)}),
+                             VMConfig())
+        # the leafs handler serves the server's ATOMIC triedb too: route all
+        # leafs requests at the atomic root to the atomic trie's database
+        from coreth_tpu.sync.handlers import LeafsRequestHandler, SyncHandler
+
+        handler = SyncHandler(server.blockchain, server.state_database.triedb,
+                              server.blockchain.diskdb)
+        atomic_leafs = LeafsRequestHandler(server.atomic_trie.triedb)
+        orig = handler.leafs.on_leafs_request
+
+        def route(req):
+            if req.root == summary.atomic_root:
+                return atomic_leafs.on_leafs_request(req)
+            return orig(req)
+
+        handler.leafs.on_leafs_request = route
+        net = Network(self_id=b"client")
+        net.connect(b"server", lambda s, r: handler.handle(s, r))
+        StateSyncClient(client_vm, SyncClient(net)).accept_summary(summary)
+
+        # synced atomic trie matches and the replayed UTXO landed in the
+        # client's view of the X chain namespace
+        assert client_vm.atomic_trie.last_committed_root == summary.atomic_root
+        assert len(list(client_vm.atomic_trie.iterate())) == 1
+        client_vm.shutdown()
+        server.shutdown()

@@ -285,3 +285,47 @@ class TestMixedBlocks:
         st = vm.blockchain.state()
         assert st.get_balance(DEST) == 4 * 10**9 * X2C_RATE + 77
         vm.shutdown()
+
+
+class TestVMConfig:
+    def test_json_config_round_trip(self):
+        import json
+
+        from coreth_tpu.vm.config import Config, parse_config
+
+        cfg = parse_config(json.dumps({
+            "pruning-enabled": False,
+            "commit-interval": 8192,
+            "state-sync-commit-interval": 16384,
+            "eth-apis": ["eth", "debug"],
+            "unknown-knob": 42,
+        }).encode())
+        assert cfg.pruning_enabled is False
+        assert cfg.commit_interval == 8192
+        assert cfg.eth_apis == ["eth", "debug"]
+
+    def test_config_validation(self):
+        import pytest as _pytest
+
+        from coreth_tpu.vm.config import Config
+
+        bad = Config(state_sync_commit_interval=1000)  # not a multiple of 4096
+        with _pytest.raises(ValueError):
+            bad.validate()
+
+    def test_vm_boots_from_config_bytes(self):
+        import json
+
+        vm = VM()
+        genesis = Genesis(
+            config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+            alloc={ADDR: GenesisAccount(balance=FUND)},
+        )
+        vm.initialize(
+            SnowContext(shared_memory=Memory()), MemoryDB(), genesis,
+            config_bytes=json.dumps({"commit-interval": 2048,
+                                     "state-sync-commit-interval": 16384}).encode(),
+        )
+        assert vm.config.commit_interval == 2048
+        assert vm.full_config.commit_interval == 2048
+        vm.shutdown()
