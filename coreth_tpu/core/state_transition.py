@@ -106,7 +106,7 @@ def intrinsic_gas(data: bytes, access_list, is_creation: bool,
             gas += ((len(data) + 31) // 32) * params.INIT_CODE_WORD_GAS
     if access_list:
         gas += len(access_list) * params.TX_ACCESS_LIST_ADDRESS_GAS
-        gas += sum(len(t.storage_keys) for t in access_list) * params.TX_ACCESS_LIST_STORAGE_KEY_GAS
+        gas += sum(len(keys) for _addr, keys in access_list) * params.TX_ACCESS_LIST_STORAGE_KEY_GAS
     return gas
 
 

@@ -155,7 +155,8 @@ def _run_bn256_mul(input_: bytes) -> bytes:
 
 def _run_bn256_pairing(input_: bytes) -> bytes:
     if len(input_) % 192 != 0:
-        raise vmerrs.ErrExecutionReverted
+        # errBadPairingInput (contracts.go:620) — plain error, burns all gas
+        raise vmerrs.ErrPrecompileFailure
     pairs = []
     for off in range(0, len(input_), 192):
         p = bn256.g1_unmarshal(input_[off : off + 64])
@@ -264,8 +265,9 @@ class _Wrapped(Precompile):
         except vmerrs.VMError:
             raise
         except Exception:
-            # malformed input → precompile failure consumes supplied gas
-            raise vmerrs.ErrExecutionReverted
+            # Malformed input → plain (non-revert) error so evm.Call burns all
+            # remaining gas, matching RunPrecompiledContract + Call semantics.
+            raise vmerrs.ErrPrecompileFailure
         return out, gas
 
 
@@ -314,7 +316,8 @@ def _blake2f_gas(input_: bytes) -> int:
 
 def _check_blake2f(input_: bytes) -> bytes:
     if len(input_) != BLAKE2F_INPUT_LEN or input_[212] not in (0, 1):
-        raise vmerrs.ErrExecutionReverted
+        # errBlake2FInvalid* (contracts.go:690-700) — plain error, burns all gas
+        raise vmerrs.ErrPrecompileFailure
     return _run_blake2f(input_)
 
 

@@ -90,8 +90,11 @@ class SyncClient:
         has_more = verify_range_proof(
             req.root, first, last, resp.keys, resp.vals, proof_db
         )
-        if resp.more and not has_more:
-            raise ProofError("server claimed more leaves but proof shows none")
+        # Trust the proof, never the peer: overwrite the server-supplied flag
+        # with the proof-derived one (parseLeafsResponse in the reference sets
+        # More = hasRightElement). A malicious more=False would otherwise
+        # silently truncate the leaf stream.
+        resp.more = has_more
 
     def get_blocks(self, block_hash: bytes, height: int, parents: int) -> List[bytes]:
         """GetBlocks: verified parent-hash-linked block bytes, newest first."""

@@ -100,8 +100,12 @@ class StateSyncer:
             if not resp.more or not resp.keys:
                 break
             start = _next_key(resp.keys[-1])
-            # persist resumable progress
-            self.diskdb.put(sync_storage_key(root, account), start)
+            # Commit the progress marker IN THE SAME batch as the leaf data it
+            # points past (trie_sync_tasks.go batch+marker commit): a crash can
+            # then only lose un-markered work, never markered-but-unwritten data.
+            batch.put(sync_storage_key(root, account), start)
+            batch.write()
+            batch = self.diskdb.new_batch()
         got = st.hash()
         if not resumed and count > 0 and got != root:
             # a full-range rebuild must reproduce the root exactly; resumed
@@ -110,8 +114,8 @@ class StateSyncer:
             raise StateSyncError(
                 f"rebuilt root mismatch: want {root.hex()[:12]} got {got.hex()[:12]}"
             )
+        batch.delete(sync_storage_key(root, account))
         batch.write()
-        self.diskdb.delete(sync_storage_key(root, account))
         return count
 
     # --- main account trie ------------------------------------------------

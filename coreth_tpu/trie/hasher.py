@@ -23,7 +23,7 @@ parallel threshold.
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence, Tuple
 
 from .. import rlp
 from ..native import keccak256 as _cpu_keccak

@@ -37,6 +37,10 @@ ErrStackOverflow = _mk("stack limit reached")
 ErrInvalidOpcode = _mk("invalid opcode")
 ErrInsufficientBalanceMC = _mk("insufficient multicoin balance for transfer")
 ErrToAddrProhibited = _mk("prohibited address cannot be called")
+# Precompile input/execution failure. NOT a revert: the reference's
+# RunPrecompiledContract returns a plain error and evm.Call then consumes all
+# remaining gas (contracts.go / evm.go Call error handling).
+ErrPrecompileFailure = _mk("precompile execution failure")
 
 
 class RevertError(VMError):

@@ -410,6 +410,19 @@ class TestPrecompiles:
             "7d87c5392aab792dc252d5de4533cc9518d38aa8dbf1925ab92386edd4009923"
         )
 
+    def test_precompile_failure_burns_all_gas(self):
+        # Malformed blake2f input (bad length) is a plain precompile error, not
+        # a revert: evm.Call must consume ALL remaining gas (ADVICE r1 #2;
+        # reference RunPrecompiledContract + Call error handling).
+        evm = make_evm()
+        evm.statedb.add_balance(ORIGIN, 10**18)
+        addr = (b"\x00" * 19) + b"\x09"
+        evm.statedb.prepare(evm.rules, ORIGIN, b"\x00" * 20, addr,
+                            list(evm.precompiles.keys()), [])
+        ret, left, err = evm.call(ORIGIN, addr, b"\x00" * 7, 50_000, 0)
+        assert err is not None and not vmerrs.is_revert(err)
+        assert left == 0
+
     @staticmethod
     def _pre_banff_config():
         cfg = params.avalanche_local_chain_config()
@@ -493,6 +506,28 @@ class TestStateTransition:
 
         # 2 nonzero + 3 zero bytes, istanbul: 21000 + 2*16 + 3*4
         assert intrinsic_gas(b"\x01\x02\x00\x00\x00", [], False, True, True, False) == 21044
+
+    def test_intrinsic_gas_access_list(self):
+        # AccessTuple entries are plain (address, keys) tuples (ADVICE r1 #1)
+        from coreth_tpu.core.state_transition import intrinsic_gas
+
+        al = [(b"\xaa" * 20, [b"\x01" * 32, b"\x02" * 32]), (b"\xbb" * 20, [])]
+        # 21000 + 2*2400 + 2*1900
+        assert intrinsic_gas(b"", al, False, True, True, False) == 21000 + 4800 + 3800
+
+    def test_access_list_tx_applies(self):
+        # end-to-end: an EIP-2930-style access list must not crash apply_message
+        from coreth_tpu.core.state_transition import GasPool, Message, apply_message
+
+        evm = make_evm(base_fee=25 * 10**9)
+        sender = b"\x44" * 20
+        evm.statedb.add_balance(sender, 10**18)
+        al = [(A2, [b"\x01" * 32])]
+        msg = Message(from_=sender, to=A2, value=1, gas_limit=50_000,
+                      gas_price=25 * 10**9, access_list=al)
+        res = apply_message(evm, msg, GasPool(8_000_000))
+        assert res.err is None
+        assert res.used_gas == 21000 + 2400 + 1900
 
     def test_contract_creation_tx(self):
         from coreth_tpu.core.state_transition import GasPool, Message, apply_message

@@ -204,6 +204,22 @@ class TestHandlersAndClient:
         assert set(resp1.keys).isdisjoint(resp2.keys)
 
 
+    def test_truncated_more_flag_overridden(self):
+        """A malicious peer sending more=False with a valid prefix proof must
+        not truncate the stream: the client overwrites `more` with the
+        proof-derived hasRightElement (ADVICE r1 #3; client.go parseLeafsResponse)."""
+        server, _ = build_server_vm()
+        net = wire_network(server)
+        client = SyncClient(net)
+        root = server.blockchain.last_accepted.root
+        resp = client.get_leafs(root, limit=1)
+        assert resp.more  # honest partial response
+        req = LeafsRequest(root, b"", b"", 1)
+        resp.more = False  # malicious truncation
+        client._verify_leafs(req, resp)
+        assert resp.more is True  # proof wins over the peer's claim
+
+
 class TestTwoVMStateSync:
     def test_full_state_sync(self):
         """Two real VMs in one process: the syncer bootstraps the server's
