@@ -1,0 +1,561 @@
+// Native MPT commit planner — the host half of the fused TPU commit.
+//
+// The round-1 profile showed the Python walk + RLP encode of the dirty set
+// costing more than the entire CPU hash baseline (4.9s vs 4.2s for 275k
+// nodes), capping the device path below 1x no matter how fast the kernel
+// is. This planner rebuilds that host work natively: given the sorted
+// (hashed-key, value) leaf set of a trie — the shape of every state-commit
+// drain in the reference (core/state/statedb.go:952 IntermediateRoot,
+// trie/trie.go:585 Commit) — it
+//
+//   1. constructs the Merkle-Patricia trie shape (hex-prefix semantics of
+//      /root/reference/trie/encoding.go, node model trie/node.go),
+//   2. lays every hashed node's RLP (child-digest slots zeroed) directly
+//      into the level-bucketed, keccak-padded segment layout that
+//      ops/keccak_fused.fused_commit consumes on device, and
+//   3. emits the patch tables (lane, byte-offset, child-row) that let the
+//      device resolve the parent<-child digest dependency chain itself.
+//
+// The same plan can instead be executed on host (execute_cpu) with the
+// threaded keccak — that is the bit-exactness oracle and the native CPU
+// baseline. Exposed over a C ABI for ctypes (no pybind11 in this image).
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o libmpt.so mpt.cpp -lpthread
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+constexpr int kRate = 136;
+
+constexpr uint64_t kRC[24] = {
+    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808aULL,
+    0x8000000080008000ULL, 0x000000000000808bULL, 0x0000000080000001ULL,
+    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008aULL,
+    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000aULL,
+    0x000000008000808bULL, 0x800000000000008bULL, 0x8000000000008089ULL,
+    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+    0x000000000000800aULL, 0x800000008000000aULL, 0x8000000080008081ULL,
+    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL};
+
+inline uint64_t rotl(uint64_t x, int n) {
+  return n == 0 ? x : (x << n) | (x >> (64 - n));
+}
+
+void keccakf(uint64_t a[25]) {
+  for (int round = 0; round < 24; ++round) {
+    uint64_t c[5], d[5];
+    for (int x = 0; x < 5; ++x)
+      c[x] = a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20];
+    for (int x = 0; x < 5; ++x)
+      d[x] = c[(x + 4) % 5] ^ rotl(c[(x + 1) % 5], 1);
+    for (int i = 0; i < 25; ++i) a[i] ^= d[i % 5];
+    static constexpr int kRot[25] = {0,  1,  62, 28, 27, 36, 44, 6,  55, 20, 3, 10, 43,
+                                     25, 39, 41, 45, 15, 21, 8,  18, 2,  61, 56, 14};
+    uint64_t b[25];
+    for (int x = 0; x < 5; ++x)
+      for (int y = 0; y < 5; ++y)
+        b[y + 5 * ((2 * x + 3 * y) % 5)] = rotl(a[x + 5 * y], kRot[x + 5 * y]);
+    for (int y = 0; y < 5; ++y)
+      for (int x = 0; x < 5; ++x)
+        a[x + 5 * y] = b[x + 5 * y] ^ (~b[(x + 1) % 5 + 5 * y] & b[(x + 2) % 5 + 5 * y]);
+    a[0] ^= kRC[round];
+  }
+}
+
+// Hash a pre-padded message of `blocks` rate blocks living at `row`.
+void keccak_padded(const uint8_t* row, int blocks, uint8_t* out) {
+  uint64_t st[25];
+  std::memset(st, 0, sizeof(st));
+  for (int b = 0; b < blocks; ++b) {
+    for (int i = 0; i < kRate / 8; ++i) {
+      uint64_t w;
+      std::memcpy(&w, row + b * kRate + 8 * i, 8);
+      st[i] ^= w;
+    }
+    keccakf(st);
+  }
+  std::memcpy(out, st, 32);
+}
+
+// ---------------------------------------------------------------------------
+// Trie shape
+// ---------------------------------------------------------------------------
+
+inline int nibble(const uint8_t* key32, int i) {
+  uint8_t b = key32[i >> 1];
+  return (i & 1) ? (b & 0xf) : (b >> 4);
+}
+
+struct Node {
+  // kind: 0 leaf, 1 extension, 2 branch
+  uint8_t kind;
+  uint8_t height;      // levels above the deepest descendant (leaf = 0)
+  int32_t depth;       // nibble depth of this node's start
+  int32_t nib_end;     // for leaf/ext: key nibbles span [depth, nib_end)
+  int64_t key_idx;     // leaf: index of its key/value; ext/branch: first key
+  int32_t enc_len;     // full RLP encoding length
+  int32_t lane;        // packed digest row if hashed, -1 if embedded
+  int32_t child[16];   // branch children node ids (-1 empty); ext: child[0]
+};
+
+struct Plan {
+  // inputs (borrowed views copied where needed)
+  std::vector<uint8_t> keys;     // n * 32
+  std::vector<uint8_t> vals;     // concatenated
+  std::vector<uint64_t> val_off; // n + 1
+  int64_t n = 0;
+
+  std::vector<Node> nodes;
+  int32_t root_id = -1;
+
+  // segment layout (fused_commit format)
+  struct Seg {
+    int32_t blocks, lanes, gstart, n_patches;
+    int64_t byte_base;            // offset of this segment in flat_msgs
+    std::vector<int32_t> node_of_lane; // real lanes -> node id
+    std::vector<int32_t> pl, po, pc;   // patch tables (lane, off, child row)
+  };
+  std::vector<Seg> segs;
+  std::vector<uint8_t> flat;     // padded segment messages
+  std::vector<int32_t> nblocks;  // per packed lane
+  std::vector<int32_t> msg_len;  // real byte length per packed lane (pads: 0)
+  int64_t total_lanes = 0;
+  int64_t total_patches = 0;
+  int64_t num_hashed = 0;
+  int32_t root_pos = -1;
+};
+
+// RLP helpers -------------------------------------------------------------
+
+inline int bytes_enc_len(const uint8_t* b, int n) {
+  if (n == 1 && b[0] < 0x80) return 1;
+  if (n < 56) return 1 + n;
+  int ll = 0;
+  for (int v = n; v; v >>= 8) ++ll;
+  return 1 + ll + n;
+}
+
+inline int list_hdr_len(int payload) {
+  if (payload < 56) return 1;
+  int ll = 0;
+  for (int v = payload; v; v >>= 8) ++ll;
+  return 1 + ll;
+}
+
+inline uint8_t* write_bytes(const uint8_t* b, int n, uint8_t* out) {
+  if (n == 1 && b[0] < 0x80) {
+    *out++ = b[0];
+  } else if (n < 56) {
+    *out++ = 0x80 + n;
+    std::memcpy(out, b, n);
+    out += n;
+  } else {
+    int ll = 0;
+    for (int v = n; v; v >>= 8) ++ll;
+    *out++ = 0xB7 + ll;
+    for (int i = ll - 1; i >= 0; --i) *out++ = (n >> (8 * i)) & 0xff;
+    std::memcpy(out, b, n);
+    out += n;
+  }
+  return out;
+}
+
+inline uint8_t* write_list_hdr(int payload, uint8_t* out) {
+  if (payload < 56) {
+    *out++ = 0xC0 + payload;
+  } else {
+    int ll = 0;
+    for (int v = payload; v; v >>= 8) ++ll;
+    *out++ = 0xF7 + ll;
+    for (int i = ll - 1; i >= 0; --i) *out++ = (payload >> (8 * i)) & 0xff;
+  }
+  return out;
+}
+
+// hex-prefix compact encoding of key nibbles [from, to) with terminator flag
+// (/root/reference/trie/encoding.go hexToCompact semantics)
+inline int compact_len(int nnib) { return 1 + nnib / 2; }
+
+inline void write_compact(const uint8_t* key32, int from, int to, bool term,
+                          uint8_t* out) {
+  int nnib = to - from;
+  bool odd = nnib & 1;
+  out[0] = (uint8_t)(((term ? 2 : 0) | (odd ? 1 : 0)) << 4);
+  int pos = 1, i = from;
+  if (odd) {
+    out[0] |= nibble(key32, i++);
+  }
+  for (; i < to; i += 2)
+    out[pos++] = (uint8_t)((nibble(key32, i) << 4) | nibble(key32, i + 1));
+}
+
+// Build -------------------------------------------------------------------
+
+struct Builder {
+  Plan& p;
+
+  // returns node id; fills enc_len/height
+  int32_t build(int64_t lo, int64_t hi, int depth) {
+    const uint8_t* k0 = p.keys.data() + lo * 32;
+    if (hi - lo == 1) {
+      Node nd{};
+      nd.kind = 0;
+      nd.depth = depth;
+      nd.nib_end = 64;
+      nd.key_idx = lo;
+      nd.height = 0;
+      int vlen = (int)(p.val_off[lo + 1] - p.val_off[lo]);
+      uint8_t tmp[34];
+      int clen = compact_len(64 - depth);
+      write_compact(k0, depth, 64, true, tmp);
+      int key_enc = bytes_enc_len(tmp, clen);
+      const uint8_t* v = p.vals.data() + p.val_off[lo];
+      int payload = key_enc + bytes_enc_len(v, vlen);
+      nd.enc_len = list_hdr_len(payload) + payload;
+      p.nodes.push_back(nd);
+      return (int32_t)p.nodes.size() - 1;
+    }
+    // longest common prefix from depth between first and last key
+    const uint8_t* kl = p.keys.data() + (hi - 1) * 32;
+    int lcp = depth;
+    while (lcp < 64 && nibble(k0, lcp) == nibble(kl, lcp)) ++lcp;
+    if (lcp > depth) {
+      int32_t child = build(lo, hi, lcp);
+      Node nd{};
+      nd.kind = 1;
+      nd.depth = depth;
+      nd.nib_end = lcp;
+      nd.key_idx = lo;
+      nd.child[0] = child;
+      Node& c = p.nodes[child];
+      nd.height = (uint8_t)(c.height + 1);
+      uint8_t tmp[34];
+      int clen = compact_len(lcp - depth);
+      write_compact(k0, depth, lcp, false, tmp);
+      int child_ref = c.enc_len < 32 ? c.enc_len : 33;
+      int payload = bytes_enc_len(tmp, clen) + child_ref;
+      nd.enc_len = list_hdr_len(payload) + payload;
+      p.nodes.push_back(nd);
+      return (int32_t)p.nodes.size() - 1;
+    }
+    // branch at `depth`
+    Node nd{};
+    nd.kind = 2;
+    nd.depth = depth;
+    nd.key_idx = lo;
+    for (int i = 0; i < 16; ++i) nd.child[i] = -1;
+    int payload = 1;  // empty 17th (value) slot: 0x80
+    int hmax = -1;
+    int64_t s = lo;
+    while (s < hi) {
+      int nb = nibble(p.keys.data() + s * 32, depth);
+      int64_t e = s + 1;
+      while (e < hi && nibble(p.keys.data() + e * 32, depth) == nb) ++e;
+      int32_t child = build(s, e, depth + 1);
+      nd.child[nb] = child;
+      Node& c = p.nodes[child];
+      payload += c.enc_len < 32 ? c.enc_len : 33;
+      hmax = std::max(hmax, (int)c.height);
+      s = e;
+    }
+    // empty child slots encode as 0x80 (1 byte each)
+    int present = 0;
+    for (int i = 0; i < 16; ++i)
+      if (nd.child[i] >= 0) ++present;
+    payload += 16 - present;
+    nd.height = (uint8_t)(hmax + 1);
+    nd.enc_len = list_hdr_len(payload) + payload;
+    p.nodes.push_back(nd);
+    return (int32_t)p.nodes.size() - 1;
+  }
+};
+
+// Segment assignment: group hashed nodes by (height level, exact block
+// count). Lane counts pad to a power of two up to 8192 and to multiples of
+// 8192 above that — a bounded jit-shape set for small segments, <=4% pad
+// waste for big ones (a pure pow2 policy wasted ~31% of the transfer on a
+// 200k-lane leaf segment). A scratch lane absorbs patch-table pad writes.
+int pow2_at_least(int v, int floor_) {
+  int t = floor_;
+  while (t < v) t <<= 1;
+  return t;
+}
+
+int round_lanes(int v) {
+  if (v <= 8192) return pow2_at_least(v, 16);
+  return (v + 8191) / 8192 * 8192;
+}
+
+struct SegKey {
+  int level, blocks;
+  bool operator<(const SegKey& o) const {
+    return level != o.level ? level < o.level : blocks < o.blocks;
+  }
+};
+
+// Write one node's RLP into `out`; children referenced by digest get a
+// patch (offset within this lane row, child node id — remapped to packed
+// row later); embedded children are written inline recursively.
+struct Writer {
+  Plan& p;
+  std::vector<std::pair<int32_t, int32_t>>& patches;  // (off, child node id)
+  uint8_t* base;
+
+  void write_child_ref(int32_t cid, uint8_t*& out) {
+    Node& c = p.nodes[cid];
+    if (c.enc_len < 32) {
+      write_node(cid, out);
+    } else {
+      *out++ = 0xA0;
+      patches.emplace_back((int32_t)(out - base), cid);
+      std::memset(out, 0, 32);
+      out += 32;
+    }
+  }
+
+  void write_node(int32_t id, uint8_t*& out) {
+    Node& nd = p.nodes[id];
+    if (nd.kind == 0) {
+      uint8_t tmp[34];
+      int clen = compact_len(64 - nd.depth);
+      write_compact(p.keys.data() + nd.key_idx * 32, nd.depth, 64, true, tmp);
+      int vlen = (int)(p.val_off[nd.key_idx + 1] - p.val_off[nd.key_idx]);
+      const uint8_t* v = p.vals.data() + p.val_off[nd.key_idx];
+      int payload = bytes_enc_len(tmp, clen) + bytes_enc_len(v, vlen);
+      out = write_list_hdr(payload, out);
+      out = write_bytes(tmp, clen, out);
+      out = write_bytes(v, vlen, out);
+    } else if (nd.kind == 1) {
+      uint8_t tmp[34];
+      int clen = compact_len(nd.nib_end - nd.depth);
+      write_compact(p.keys.data() + nd.key_idx * 32, nd.depth, nd.nib_end,
+                    false, tmp);
+      Node& c = p.nodes[nd.child[0]];
+      int child_ref = c.enc_len < 32 ? c.enc_len : 33;
+      int payload = bytes_enc_len(tmp, clen) + child_ref;
+      out = write_list_hdr(payload, out);
+      out = write_bytes(tmp, clen, out);
+      write_child_ref(nd.child[0], out);
+    } else {
+      int payload = 1;
+      for (int i = 0; i < 16; ++i) {
+        if (nd.child[i] >= 0) {
+          Node& c = p.nodes[nd.child[i]];
+          payload += c.enc_len < 32 ? c.enc_len : 33;
+        } else {
+          payload += 1;
+        }
+      }
+      out = write_list_hdr(payload, out);
+      for (int i = 0; i < 16; ++i) {
+        if (nd.child[i] >= 0)
+          write_child_ref(nd.child[i], out);
+        else
+          *out++ = 0x80;
+      }
+      *out++ = 0x80;  // empty value slot (fixed-length keys: never occupied)
+    }
+  }
+};
+
+void layout(Plan& p) {
+  // bucket hashed nodes by (level, blocks)
+  std::vector<std::pair<SegKey, int32_t>> entries;  // key -> node id
+  entries.reserve(p.nodes.size());
+  for (int32_t id = 0; id < (int32_t)p.nodes.size(); ++id) {
+    Node& nd = p.nodes[id];
+    bool hashed = nd.enc_len >= 32 || id == p.root_id;
+    nd.lane = -1;
+    if (!hashed) continue;
+    int blocks = nd.enc_len / kRate + 1;
+    entries.push_back({{nd.height, blocks}, id});
+  }
+  std::stable_sort(entries.begin(), entries.end(),
+                   [](const auto& a, const auto& b) { return a.first < b.first; });
+  p.num_hashed = (int64_t)entries.size();
+
+  int64_t byte_base = 0;
+  int32_t gstart = 0;
+  size_t i = 0;
+  while (i < entries.size()) {
+    size_t j = i;
+    while (j < entries.size() && !(entries[i].first < entries[j].first)) ++j;
+    int count = (int)(j - i);
+    Plan::Seg seg;
+    seg.blocks = entries[i].first.blocks;
+    // +1 scratch lane for patch-pad writes
+    seg.lanes = round_lanes(count + 1);
+    seg.gstart = gstart;
+    seg.byte_base = byte_base;
+    seg.node_of_lane.reserve(count);
+    for (size_t k = i; k < j; ++k) {
+      int32_t id = entries[k].second;
+      p.nodes[id].lane = gstart + (int32_t)(k - i);
+      seg.node_of_lane.push_back(id);
+    }
+    gstart += seg.lanes;
+    byte_base += (int64_t)seg.lanes * seg.blocks * kRate;
+    p.segs.push_back(std::move(seg));
+    i = j;
+  }
+  p.total_lanes = gstart;
+  p.flat.assign(byte_base, 0);
+  p.nblocks.assign(gstart, 1);
+  p.msg_len.assign(gstart, 0);
+
+  // write every hashed node's RLP into its padded row + collect patches
+  p.total_patches = 0;
+  for (auto& seg : p.segs) {
+    int width = seg.blocks * kRate;
+    std::vector<std::pair<int32_t, int32_t>> patches;  // (global off in row, cid)
+    std::vector<std::pair<int32_t, int32_t>> lane_patches;
+    seg.pl.clear();
+    seg.po.clear();
+    seg.pc.clear();
+    for (int lane = 0; lane < (int)seg.node_of_lane.size(); ++lane) {
+      int32_t id = seg.node_of_lane[lane];
+      Node& nd = p.nodes[id];
+      uint8_t* row = p.flat.data() + seg.byte_base + (int64_t)lane * width;
+      patches.clear();
+      Writer w{p, patches, row};
+      uint8_t* out = row;
+      w.write_node(id, out);
+      int len = (int)(out - row);
+      // keccak pad10*1
+      row[len] ^= 0x01;
+      row[width - 1] ^= 0x80;
+      int32_t g = seg.gstart + lane;
+      p.nblocks[g] = seg.blocks;
+      p.msg_len[g] = len;
+      for (auto& pr : patches) {
+        seg.pl.push_back(lane);
+        seg.po.push_back(pr.first);
+        seg.pc.push_back(p.nodes[pr.second].lane);  // packed child row
+      }
+    }
+    // pad patch table to pow2 >= 16; writes land in the scratch lane
+    int np = (int)seg.pl.size();
+    seg.n_patches = np ? pow2_at_least(np, 16) : 0;
+    int scratch = seg.lanes - 1;
+    for (int k = np; k < seg.n_patches; ++k) {
+      seg.pl.push_back(scratch);
+      seg.po.push_back(0);
+      seg.pc.push_back(0);
+    }
+    p.total_patches += seg.n_patches;
+  }
+  p.root_pos = p.nodes[p.root_id].lane;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* mpt_plan(const uint8_t* keys, const uint8_t* vals,
+               const uint64_t* val_off, uint64_t n) {
+  if (n == 0) return nullptr;  // empty trie: caller returns EMPTY_ROOT
+  // reject duplicate keys: the build recursion assumes strictly-sorted
+  // distinct keys (a duplicate would read past nibble 64)
+  for (uint64_t i = 1; i < n; ++i)
+    if (std::memcmp(keys + (i - 1) * 32, keys + i * 32, 32) >= 0) return nullptr;
+  Plan* p = new Plan();
+  p->n = (int64_t)n;
+  p->keys.assign(keys, keys + n * 32);
+  p->vals.assign(vals, vals + val_off[n]);
+  p->val_off.assign(val_off, val_off + n + 1);
+  p->nodes.reserve((size_t)(n * 15 / 10) + 16);
+  Builder b{*p};
+  p->root_id = b.build(0, (int64_t)n, 0);
+  layout(*p);
+  return p;
+}
+
+uint64_t mpt_plan_flat_bytes(void* h) { return ((Plan*)h)->flat.size(); }
+uint64_t mpt_plan_total_lanes(void* h) { return ((Plan*)h)->total_lanes; }
+uint64_t mpt_plan_num_segments(void* h) { return ((Plan*)h)->segs.size(); }
+uint64_t mpt_plan_total_patches(void* h) { return ((Plan*)h)->total_patches; }
+uint64_t mpt_plan_num_hashed(void* h) { return ((Plan*)h)->num_hashed; }
+uint64_t mpt_plan_num_nodes(void* h) { return ((Plan*)h)->nodes.size(); }
+int32_t mpt_plan_root_pos(void* h) { return ((Plan*)h)->root_pos; }
+
+// specs: int32[num_segments, 4] = (blocks, lanes, gstart, n_patches)
+void mpt_plan_export(void* h, uint8_t* flat_msgs, int32_t* nblocks,
+                     int32_t* patch_lane, int32_t* patch_off,
+                     int32_t* patch_child, int32_t* specs) {
+  Plan* p = (Plan*)h;
+  std::memcpy(flat_msgs, p->flat.data(), p->flat.size());
+  std::memcpy(nblocks, p->nblocks.data(), p->nblocks.size() * 4);
+  int64_t pp = 0;
+  for (size_t s = 0; s < p->segs.size(); ++s) {
+    auto& seg = p->segs[s];
+    specs[4 * s + 0] = seg.blocks;
+    specs[4 * s + 1] = seg.lanes;
+    specs[4 * s + 2] = seg.gstart;
+    specs[4 * s + 3] = seg.n_patches;
+    std::memcpy(patch_lane + pp, seg.pl.data(), seg.pl.size() * 4);
+    std::memcpy(patch_off + pp, seg.po.data(), seg.po.size() * 4);
+    std::memcpy(patch_child + pp, seg.pc.data(), seg.pc.size() * 4);
+    pp += seg.n_patches;
+  }
+}
+
+// Execute the plan on host: per level-segment, patch child digests then
+// hash lanes with `threads` workers. digests_out: uint8[total_lanes * 32].
+// Returns the root digest in out_root32. This is the native CPU baseline
+// and the oracle for device bit-exactness.
+void mpt_plan_execute_cpu(void* h, int threads, uint8_t* digests_out,
+                          uint8_t* out_root32) {
+  Plan* p = (Plan*)h;
+  std::vector<uint8_t> local;
+  uint8_t* dig = digests_out;
+  if (!dig) {
+    local.assign((size_t)p->total_lanes * 32, 0);
+    dig = local.data();
+  }
+  for (auto& seg : p->segs) {
+    int width = seg.blocks * kRate;
+    int real = (int)seg.node_of_lane.size();
+    // patches reference earlier segments only — safe to apply before hashing
+    for (size_t k = 0; k < seg.pl.size(); ++k) {
+      if (seg.pl[k] >= real) continue;  // scratch-lane padding
+      std::memcpy(p->flat.data() + seg.byte_base +
+                      (int64_t)seg.pl[k] * width + seg.po[k],
+                  dig + (int64_t)seg.pc[k] * 32, 32);
+    }
+    auto hash_range = [&](int from, int to) {
+      for (int lane = from; lane < to; ++lane) {
+        keccak_padded(p->flat.data() + seg.byte_base + (int64_t)lane * width,
+                      seg.blocks, dig + ((int64_t)seg.gstart + lane) * 32);
+      }
+    };
+    if (threads > 1 && real >= 256) {
+      // hardware_concurrency() may return 0 (unknown) — clamp to >= 1
+      int hw = std::max(1u, std::thread::hardware_concurrency());
+      int t = std::min(threads, hw);
+      std::vector<std::thread> pool;
+      int chunk = (real + t - 1) / t;
+      for (int i = 0; i < t; ++i)
+        pool.emplace_back(hash_range, i * chunk,
+                          std::min(real, (i + 1) * chunk));
+      for (auto& th : pool) th.join();
+    } else {
+      hash_range(0, real);
+    }
+  }
+  std::memcpy(out_root32, dig + (int64_t)p->root_pos * 32, 32);
+}
+
+// Per-lane real message lengths (for exporting node RLP to the store).
+void mpt_plan_msg_lens(void* h, int32_t* out) {
+  Plan* p = (Plan*)h;
+  std::memcpy(out, p->msg_len.data(), p->msg_len.size() * 4);
+}
+
+void mpt_plan_free(void* h) { delete (Plan*)h; }
+
+}  // extern "C"

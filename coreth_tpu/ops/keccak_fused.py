@@ -28,72 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .keccak_jax import RATE, WORDS_PER_BLOCK
-from .keccak_ref import _ROUND_CONSTANTS, _ROTC
-
-_RC_LO = np.array([rc & 0xFFFFFFFF for rc in _ROUND_CONSTANTS], dtype=np.uint32)
-_RC_HI = np.array([rc >> 32 for rc in _ROUND_CONSTANTS], dtype=np.uint32)
-
-
-def _rotl_pair(lo, hi, n: int):
-    n %= 64
-    if n == 0:
-        return lo, hi
-    if n == 32:
-        return hi, lo
-    if n > 32:
-        lo, hi = hi, lo
-        n -= 32
-    m = 32 - n
-    return (lo << n) | (hi >> m), (hi << n) | (lo >> m)
-
-
-def _keccak_f1600_scanned(lo, hi):
-    """24 rounds via lax.scan — tiny trace (one round body), same math as
-    keccak_jax.keccak_f1600. lo/hi: uint32[25, P]."""
-
-    def round_fn(state, rc):
-        lo, hi = state
-        rc_lo, rc_hi = rc
-        c_lo = [lo[x] ^ lo[x + 5] ^ lo[x + 10] ^ lo[x + 15] ^ lo[x + 20] for x in range(5)]
-        c_hi = [hi[x] ^ hi[x + 5] ^ hi[x + 10] ^ hi[x + 15] ^ hi[x + 20] for x in range(5)]
-        d_lo, d_hi = [], []
-        for x in range(5):
-            rl, rh = _rotl_pair(c_lo[(x + 1) % 5], c_hi[(x + 1) % 5], 1)
-            d_lo.append(c_lo[(x - 1) % 5] ^ rl)
-            d_hi.append(c_hi[(x - 1) % 5] ^ rh)
-        lo2 = [lo[i] ^ d_lo[i % 5] for i in range(25)]
-        hi2 = [hi[i] ^ d_hi[i % 5] for i in range(25)]
-        b_lo = [None] * 25
-        b_hi = [None] * 25
-        for x in range(5):
-            for y in range(5):
-                src = x + 5 * y
-                dst = y + 5 * ((2 * x + 3 * y) % 5)
-                b_lo[dst], b_hi[dst] = _rotl_pair(lo2[src], hi2[src], _ROTC[src])
-        lo3 = [
-            b_lo[i] ^ (~b_lo[(i % 5 + 1) % 5 + 5 * (i // 5)] & b_lo[(i % 5 + 2) % 5 + 5 * (i // 5)])
-            for i in range(25)
-        ]
-        hi3 = [
-            b_hi[i] ^ (~b_hi[(i % 5 + 1) % 5 + 5 * (i // 5)] & b_hi[(i % 5 + 2) % 5 + 5 * (i // 5)])
-            for i in range(25)
-        ]
-        lo3[0] = lo3[0] ^ rc_lo
-        hi3[0] = hi3[0] ^ rc_hi
-        return (jnp.stack(lo3), jnp.stack(hi3)), None
-
-    lo_s = lo if isinstance(lo, jnp.ndarray) else jnp.stack(lo)
-    hi_s = hi if isinstance(hi, jnp.ndarray) else jnp.stack(hi)
-
-    def body(state, rc):
-        (l, h) = state
-        return round_fn((list(l), list(h)), rc)
-
-    (lo_s, hi_s), _ = jax.lax.scan(
-        body, (lo_s, hi_s), (jnp.asarray(_RC_LO), jnp.asarray(_RC_HI))
-    )
-    return lo_s, hi_s
+from .keccak_jax import RATE, WORDS_PER_BLOCK, keccak_f1600_scanned_stacked
 
 
 class SegmentSpec(NamedTuple):
@@ -151,7 +86,7 @@ def _keccak_segment(words: jnp.ndarray, nblocks: jnp.ndarray) -> jnp.ndarray:
         )
         lo = lo ^ absorb_lo
         hi = hi ^ absorb_hi
-        lo, hi = _keccak_f1600_scanned(lo, hi)
+        lo, hi = keccak_f1600_scanned_stacked(lo, hi)
         digest = jnp.stack(
             [lo[0], hi[0], lo[1], hi[1], lo[2], hi[2], lo[3], hi[3]], axis=1
         )
@@ -257,7 +192,6 @@ class FusedBatch:
             patches = level["patches"]  # (msg_idx, off, child_gid)
 
             # bucket by power-of-two block count
-            keys = np.where(nb > 1, 1 << (32 - ((nb - 1) >> 0).astype(np.uint32).byteswap().view(np.uint8).reshape(-1, 4)[:, 0]), 1) if False else None
             keys = np.asarray([1 << int(b - 1).bit_length() if b > 1 else 1 for b in nb])
             patch_msgs = {mi for mi, _, _ in patches}
             for key in np.unique(keys):

@@ -93,10 +93,44 @@ def _round(lo, hi, rc_lo: int, rc_hi: int):
 
 
 def keccak_f1600(lo, hi):
-    """Full 24-round permutation; lo/hi are length-25 lists of uint32[B]."""
+    """Full 24-round permutation; lo/hi are length-25 lists of uint32[B].
+
+    Fully unrolled — largest trace, kept for parity tests. The production
+    paths use the scanned variant below (one round body traced once), which
+    compiles orders of magnitude faster on the CPU backend and identically
+    fast on TPU."""
     for r in range(24):
         lo, hi = _round(lo, hi, _RC_LO[r], _RC_HI[r])
     return lo, hi
+
+
+_RC_LO_ARR = np.array(_RC_LO, dtype=np.uint32)
+_RC_HI_ARR = np.array(_RC_HI, dtype=np.uint32)
+
+
+def keccak_f1600_scanned_stacked(lo_s, hi_s):
+    """Scanned 24-round permutation over stacked state uint32[25, ...].
+
+    The round body is traced ONCE (lax.scan over round constants), keeping
+    the XLA graph ~24x smaller than the unrolled form — this is what makes
+    multi-chip sharded compiles finish in seconds instead of minutes. This
+    is the single shared implementation; keccak_fused/keccak_staged import
+    it rather than keeping their own copies."""
+
+    def body(state, rc):
+        l, h = state
+        l2, h2 = _round(list(l), list(h), rc[0], rc[1])
+        return (jnp.stack(l2), jnp.stack(h2)), None
+
+    rcs = jnp.stack([jnp.asarray(_RC_LO_ARR), jnp.asarray(_RC_HI_ARR)], axis=1)
+    (lo_s, hi_s), _ = jax.lax.scan(body, (lo_s, hi_s), rcs)
+    return lo_s, hi_s
+
+
+def keccak_f1600_scanned(lo, hi):
+    """List-of-25-vectors wrapper over the stacked scanned permutation."""
+    lo_s, hi_s = keccak_f1600_scanned_stacked(jnp.stack(lo), jnp.stack(hi))
+    return list(lo_s), list(hi_s)
 
 
 @functools.partial(jax.jit, static_argnames=("unroll",))
@@ -125,7 +159,7 @@ def keccak256_blocks(words: jax.Array, nblocks: jax.Array, unroll: int = 1):
         for i in range(17):
             lo[i] = lo[i] ^ (block[:, 2 * i] * live)
             hi[i] = hi[i] ^ (block[:, 2 * i + 1] * live)
-        lo, hi = keccak_f1600(lo, hi)
+        lo, hi = keccak_f1600_scanned(lo, hi)
         digest = jnp.stack(
             [lo[0], hi[0], lo[1], hi[1], lo[2], hi[2], lo[3], hi[3]], axis=1
         )

@@ -1,0 +1,146 @@
+"""Staged trie commit: per-segment dispatches, async-pipelined, with the
+digest array resident on device.
+
+The fused single-dispatch design (keccak_fused.py) inlines every segment
+into one XLA module — minimal dispatch count, but the compile time grows
+with segment count (~170s for a 200k-leaf commit's ~30 segments on TPU)
+and the whole 50+MB transfer must complete before compute starts.
+
+The staged design instead jits ONE small program per segment *shape*
+(blocks, lanes, patch count) and chains them through a donated device
+digest buffer:
+
+    dig8 = zeros[G, 32]                        # device-resident
+    for seg in plan.segments:                  # host loop, all async
+        x    = device_put(seg.bytes)           # h2d overlaps earlier compute
+        dig8 = seg_step(dig8, x, patches, gstart)
+    root = dig8[root_pos]                      # the only forced sync
+
+Dispatches never synchronize in between, so XLA pipelines transfer of
+segment k+1 with compute of segment k; the jit cache is keyed by a small
+set of shapes (lane counts pad pow2<=8192 then multiples of 8192) that the
+persistent compilation cache reuses across processes.
+
+Within a segment every lane has the SAME rate-block count (the planner
+buckets exactly), so the kernel needs no masking or digest snapshotting:
+absorb all blocks, final state is the digest. Child digests come from
+`dig8` via gather and are scattered into the raw bytes before word
+packing — the parent<-child dependency chain never touches the host
+(reference contrast: trie/hasher.go:124-139 resolves it with goroutines
+and channel joins).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .keccak_fused import _u8_to_words, _words_to_u8
+from .keccak_jax import RATE, keccak_f1600_scanned_stacked
+
+
+def _segment_keccak(words: jax.Array) -> jax.Array:
+    """uint32[P, L, 34] -> uint32[P, 8]; all lanes have exactly L blocks."""
+    p = words.shape[0]
+    lo = jnp.zeros((25, p), jnp.uint32)
+    hi = jnp.zeros((25, p), jnp.uint32)
+    words_t = jnp.transpose(words, (1, 0, 2))  # [L, P, 34]
+
+    def step(carry, block):
+        lo, hi = carry
+        absorb_lo = jnp.concatenate(
+            [jnp.transpose(block[:, 0:34:2]), jnp.zeros((8, p), jnp.uint32)]
+        )
+        absorb_hi = jnp.concatenate(
+            [jnp.transpose(block[:, 1:34:2]), jnp.zeros((8, p), jnp.uint32)]
+        )
+        lo, hi = keccak_f1600_scanned_stacked(lo ^ absorb_lo, hi ^ absorb_hi)
+        return (lo, hi), None
+
+    (lo, hi), _ = jax.lax.scan(step, (lo, hi), words_t)
+    return jnp.stack([lo[0], hi[0], lo[1], hi[1], lo[2], hi[2], lo[3], hi[3]],
+                     axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("blocks",), donate_argnums=(0,))
+def _seg_step_patched(dig8, seg_u8, pl, po, pc, gstart, *, blocks: int):
+    """One segment with child-digest patches; dig8 donated (in-place)."""
+    vals = dig8[pc]  # [NP, 32] gather from earlier segments
+    ar32 = jnp.arange(32)
+    seg_u8 = seg_u8.at[pl[:, None], po[:, None] + ar32[None, :]].set(vals)
+    out = _segment_keccak(_u8_to_words(seg_u8, blocks))
+    return jax.lax.dynamic_update_slice(dig8, _words_to_u8(out), (gstart, 0))
+
+
+@functools.partial(jax.jit, static_argnames=("blocks",), donate_argnums=(0,))
+def _seg_step_plain(dig8, seg_u8, gstart, *, blocks: int):
+    """Patch-free segment (leaves)."""
+    out = _segment_keccak(_u8_to_words(seg_u8, blocks))
+    return jax.lax.dynamic_update_slice(dig8, _words_to_u8(out), (gstart, 0))
+
+
+class StagedCommit:
+    """Execute a CommitPlan's segment layout with pipelined dispatches.
+
+    seg_impl: optional override of the per-segment keccak
+    (uint32[P, L, 34] -> uint32[P, 8]) — the Pallas kernel plugs in here.
+    """
+
+    def __init__(self, seg_impl=None):
+        if seg_impl is None:
+            self._patched = _seg_step_patched
+            self._plain = _seg_step_plain
+        else:
+            @functools.partial(jax.jit, static_argnames=("blocks",),
+                               donate_argnums=(0,))
+            def patched(dig8, seg_u8, pl, po, pc, gstart, *, blocks):
+                vals = dig8[pc]
+                ar32 = jnp.arange(32)
+                seg_u8 = seg_u8.at[pl[:, None], po[:, None] + ar32[None, :]].set(vals)
+                out = seg_impl(_u8_to_words(seg_u8, blocks))
+                return jax.lax.dynamic_update_slice(
+                    dig8, _words_to_u8(out), (gstart, 0))
+
+            @functools.partial(jax.jit, static_argnames=("blocks",),
+                               donate_argnums=(0,))
+            def plain(dig8, seg_u8, gstart, *, blocks):
+                out = seg_impl(_u8_to_words(seg_u8, blocks))
+                return jax.lax.dynamic_update_slice(
+                    dig8, _words_to_u8(out), (gstart, 0))
+
+            self._patched = patched
+            self._plain = plain
+
+    def run(self, specs, flat: np.ndarray, nblocks: np.ndarray,
+            patch_lane: np.ndarray, patch_off: np.ndarray,
+            patch_child: np.ndarray, root_pos: int,
+            want_digests: bool = True) -> Tuple[bytes, Optional[np.ndarray]]:
+        """Inputs in the fused_commit array format (CommitPlan.export())."""
+        total = int(nblocks.shape[0])
+        dig8 = jnp.zeros((total, 32), jnp.uint8)
+        byte_base = 0
+        patch_pos = 0
+        for spec in specs:
+            width = spec.blocks * RATE
+            size = spec.lanes * width
+            seg = flat[byte_base:byte_base + size].reshape(spec.lanes, width)
+            byte_base += size
+            x = jax.device_put(seg)
+            g = jnp.int32(spec.gstart)
+            if spec.n_patches:
+                pl = jax.device_put(patch_lane[patch_pos:patch_pos + spec.n_patches])
+                po = jax.device_put(patch_off[patch_pos:patch_pos + spec.n_patches])
+                pc = jax.device_put(patch_child[patch_pos:patch_pos + spec.n_patches])
+                patch_pos += spec.n_patches
+                dig8 = self._patched(dig8, x, pl, po, pc, g, blocks=spec.blocks)
+            else:
+                dig8 = self._plain(dig8, x, g, blocks=spec.blocks)
+        if want_digests:
+            host = np.asarray(dig8)
+            return host[root_pos].tobytes(), host
+        root = np.asarray(dig8[root_pos])
+        return root.tobytes(), None

@@ -2,16 +2,30 @@
 
 Multi-chip hardware is not available in CI; sharding correctness is tested on
 XLA's host-platform virtual devices, exactly as the driver's dryrun does.
-Must run before the first jax import.
+
+The ambient environment preloads jax via sitecustomize with
+JAX_PLATFORMS=axon (one real TPU chip behind a high-latency tunnel), so
+overwriting the env var here is too late — jax.config was already computed at
+import. Backends initialize lazily, though, so updating jax.config and
+XLA_FLAGS before the first jax.devices() call still takes effect.
 """
 
 import os
 
-# Overwrite (not setdefault): the ambient environment may pin an accelerator
-# plugin via JAX_PLATFORMS, which would leave tests on one real chip.
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) >= 8, "expected 8 virtual CPU devices for tests"
+
+# Persist XLA compiles across test runs — the CPU backend pays multi-second
+# compiles for the keccak scan programs; the disk cache makes rerun cheap.
+from coreth_tpu.utils import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()

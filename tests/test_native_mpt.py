@@ -1,0 +1,93 @@
+"""Native MPT commit planner parity tests.
+
+The planner (native/mpt.cpp) must reproduce the Python Trie's root
+bit-exactly on both its host (threaded keccak) and device (fused_commit)
+execution paths — the CPU-vs-TPU parity discipline of SURVEY.md §4
+(trie/trie_test.go:601 TestRandom, :837 TestCommitSequence seeds).
+"""
+
+import random
+
+import pytest
+
+from coreth_tpu.native.mpt import plan_from_items
+from coreth_tpu.trie.trie import Trie
+
+
+@pytest.fixture(autouse=True)
+def _require_native():
+    # lazy: the g++ build only runs when these tests are selected, not at
+    # collection time
+    from coreth_tpu.native.mpt import load
+
+    if load() is None:
+        pytest.skip("native planner unavailable")
+
+
+def _random_items(n, vmin, vmax, seed):
+    rng = random.Random(seed)
+    items = {}
+    for _ in range(n):
+        items[rng.randbytes(32)] = rng.randbytes(rng.randint(vmin, vmax))
+    return list(items.items())
+
+
+def _trie_root(items):
+    t = Trie()
+    for k, v in items:
+        t.update(k, v)
+    return t.hash()
+
+
+class TestNativePlanParity:
+    @pytest.mark.parametrize("n,vmin,vmax,seed", [
+        (1, 1, 40, 0),
+        (2, 1, 4, 1),
+        (50, 1, 10, 2),       # many embedded (<32B) nodes
+        (500, 40, 90, 3),     # account-sized values
+        (2000, 1, 200, 4),    # mixed incl. multi-block leaves
+    ])
+    def test_cpu_root_matches_python_trie(self, n, vmin, vmax, seed):
+        items = _random_items(n, vmin, vmax, seed)
+        plan = plan_from_items(items)
+        assert plan.execute_cpu(threads=1) == _trie_root(items)
+
+    def test_threaded_matches_single(self):
+        items = _random_items(3000, 30, 100, 9)
+        plan = plan_from_items(items)
+        assert plan.execute_cpu(threads=1) == plan.execute_cpu(threads=8)
+
+    def test_device_root_matches_cpu(self):
+        items = _random_items(1500, 1, 120, 11)
+        plan = plan_from_items(items)
+        root_cpu = plan.execute_cpu()
+        root_dev, dig8 = plan.execute_device()
+        assert root_dev == root_cpu
+        assert dig8.shape[1] == 32
+
+    def test_single_leaf_and_tiny_values(self):
+        for items in ([(b"\x11" * 32, b"v")],
+                      [(b"\x00" * 32, b"\x01"), (b"\xff" * 32, b"\x02")]):
+            plan = plan_from_items(items)
+            assert plan.execute_cpu() == _trie_root(items)
+
+    def test_empty_set_rejected(self):
+        with pytest.raises(ValueError):
+            plan_from_items([])
+
+    def test_duplicate_keys_last_write_wins(self):
+        k = b"\x42" * 32
+        items = [(k, b"first"), (b"\x01" * 32, b"x"), (k, b"second")]
+        plan = plan_from_items(items)
+        assert plan.execute_cpu() == _trie_root([(b"\x01" * 32, b"x"),
+                                                 (k, b"second")])
+
+    def test_unsorted_input_rejected_by_plan_commit(self):
+        import numpy as np
+
+        from coreth_tpu.native.mpt import plan_commit
+
+        keys = np.frombuffer(b"\xff" * 32 + b"\x00" * 32, dtype=np.uint8).reshape(2, 32)
+        off = np.array([0, 1, 2], dtype=np.uint64)
+        with pytest.raises(ValueError):
+            plan_commit(keys, b"ab", off)

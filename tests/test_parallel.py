@@ -1,0 +1,65 @@
+"""Multi-chip sharding tests on the virtual 8-device CPU mesh (conftest.py).
+
+The reference's parallel hashing is a 16-goroutine fan-out per branch node
+(/root/reference/trie/hasher.go:124-139); the TPU-native analog shards the
+batch over a jax.sharding.Mesh. These tests validate digest bit-exactness
+and the cross-shard collective on the same virtual mesh the driver's
+dryrun_multichip uses.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from coreth_tpu.ops.keccak_jax import digest_words_to_bytes, pack_messages
+from coreth_tpu.ops.keccak_ref import keccak256 as ref_keccak
+from coreth_tpu.parallel import ShardedKeccak, commit_step, make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return make_mesh(8)
+
+
+class TestShardedKeccak:
+    def test_digest_parity_mixed_lengths(self, mesh):
+        sk = ShardedKeccak(mesh)
+        msgs = [bytes([i % 256]) * (1 + 11 * i) for i in range(50)]
+        got = sk.digests(msgs)
+        assert got == [ref_keccak(m) for m in msgs]
+
+    def test_empty_and_single(self, mesh):
+        sk = ShardedKeccak(mesh)
+        assert sk.digests([]) == []
+        assert sk.digests([b""]) == [ref_keccak(b"")]
+
+    def test_batch_not_divisible_by_mesh(self, mesh):
+        # 13 lanes over 8 devices: padding must keep results exact
+        sk = ShardedKeccak(mesh)
+        msgs = [b"x" * (140 * i + 1) for i in range(13)]
+        assert sk.digests(msgs) == [ref_keccak(m) for m in msgs]
+
+    def test_output_is_sharded(self, mesh):
+        # the device batch really is split across the mesh (not replicated)
+        sk = ShardedKeccak(mesh)
+        msgs = [bytes([i]) * 40 for i in range(64)]
+        words, nblocks = pack_messages(msgs)
+        out = sk._fn(
+            jax.device_put(np.asarray(words), sk._sharding),
+            jax.device_put(np.asarray(nblocks), sk._sharding),
+        )
+        assert len(out.sharding.device_set) == 8
+
+
+class TestCommitStep:
+    def test_checksum_collective(self, mesh):
+        step = commit_step(mesh)
+        msgs = [bytes([i]) * (1 + 7 * i) for i in range(32)]
+        words, nblocks = pack_messages(msgs)
+        out, checksum = step(words, nblocks)
+        out = np.asarray(out)
+        digests = digest_words_to_bytes(out)
+        assert digests == [ref_keccak(m) for m in msgs]
+        # the psum-style reduction over the sharded digest tensor matches host
+        assert int(np.asarray(checksum)) == int(np.sum(out, dtype=np.uint32))
