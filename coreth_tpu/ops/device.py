@@ -1,0 +1,45 @@
+"""Device-hasher resolution for the production chain path.
+
+The reference engages its parallel hasher automatically from the hot path
+(/root/reference/trie/trie.go:618-619: >=100 unhashed nodes -> 16
+goroutines). The TPU-native equivalent: `get_batch_keccak("auto")` hands
+the chain a batched device keccak (ops/keccak_jax.BatchedKeccak) that
+Trie.hash() engages above trie/hasher.BATCH_THRESHOLD, with the recursive
+C++-keccak hasher below it. "off" keeps everything on the CPU hasher.
+
+Resolution is lazy and fails soft: when JAX/the device backend is
+unavailable the chain silently runs CPU-only — hashing is bit-exact either
+way, so this is purely a throughput decision.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+_cached: dict = {}
+
+
+def get_batch_keccak(mode: str = "auto") -> Optional[Callable]:
+    """Resolve a `list[bytes] -> list[bytes32]` batched keccak, or None.
+
+    mode: "auto" | "batched" — device-batched hashing (same callable; auto
+          exists so config files can distinguish "default" from "forced")
+          "off" — None (CPU recursive hasher everywhere)
+    """
+    if mode == "off":
+        return None
+    if mode not in ("auto", "batched"):
+        raise ValueError(f"unknown device-hasher mode {mode!r}")
+    if "fn" in _cached:
+        return _cached["fn"]
+    try:
+        from ..utils import enable_compilation_cache
+
+        enable_compilation_cache()
+        from .keccak_jax import BatchedKeccak
+
+        fn = BatchedKeccak().digests
+    except Exception:
+        fn = None
+    _cached["fn"] = fn
+    return fn

@@ -162,3 +162,94 @@ def pallas_impl(interpret: bool = False):
         return keccak256_blocks_pallas(words, nblocks, interpret=interpret)
 
     return impl
+
+
+# ---------------------------------------------------------------------------
+# Segment kernel for the staged commit (ops/keccak_staged.py)
+# ---------------------------------------------------------------------------
+
+
+def _make_segment_kernel(num_blocks: int):
+    """Mask-free variant: every lane has exactly num_blocks rate blocks
+    (the native planner buckets segments by exact block count), so there is
+    no nblocks input, no live-lane masking, and no digest snapshotting —
+    the digest is simply the state after the final permutation."""
+
+    def kernel(words_ref, out_ref):
+        shape = words_ref.shape[-2:]  # (8, 128) lane tile
+        zeros = jnp.zeros(shape, jnp.uint32)
+        lo = [zeros] * 25
+        hi = [zeros] * 25
+
+        def absorb_permute(lo, hi, j):
+            lo = list(lo)
+            hi = list(hi)
+            for i in range(17):
+                lo[i] = lo[i] ^ words_ref[j, 2 * i]
+                hi[i] = hi[i] ^ words_ref[j, 2 * i + 1]
+            return _permute(lo, hi)
+
+        if num_blocks <= _UNROLL_MAX_BLOCKS:
+            for j in range(num_blocks):
+                lo, hi = absorb_permute(lo, hi, j)
+        else:
+            def body(j, carry):
+                lo, hi = carry
+                lo, hi = absorb_permute(list(lo), list(hi), j)
+                return tuple(lo), tuple(hi)
+
+            lo, hi = jax.lax.fori_loop(
+                0, num_blocks, body, (tuple(lo), tuple(hi))
+            )
+        digest = [lo[0], hi[0], lo[1], hi[1], lo[2], hi[2], lo[3], hi[3]]
+        for w in range(8):
+            out_ref[w] = digest[w]
+
+    return kernel
+
+
+def segment_keccak_pallas(words: jax.Array, interpret: bool = False) -> jax.Array:
+    """uint32[P, L, 34] -> uint32[P, 8]; P must be a multiple of 1024.
+
+    Drop-in for keccak_staged._segment_keccak on lane counts the TPU grid
+    can tile (the staged runner falls back to the XLA scan for smaller
+    segments). State lives in VMEM across every round and block — one HBM
+    read of the message words, one HBM write of digests."""
+    p, num_blocks, _ = words.shape
+    assert p % 1024 == 0, "pallas segment batch must be a multiple of 1024 lanes"
+    rows = p // 128
+    w = jnp.transpose(words, (1, 2, 0)).reshape(
+        num_blocks, WORDS_PER_BLOCK, rows, 128
+    )
+    grid = (rows // 8,)
+    out = pl.pallas_call(
+        _make_segment_kernel(num_blocks),
+        out_shape=jax.ShapeDtypeStruct((8, rows, 128), jnp.uint32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (num_blocks, WORDS_PER_BLOCK, 8, 128), lambda r: (0, 0, r, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec((8, 8, 128), lambda r: (0, r, 0)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(w)
+    return jnp.transpose(out.reshape(8, p), (1, 0))
+
+
+def staged_seg_impl(interpret: bool = False):
+    """seg_impl for keccak_staged.StagedCommit: Pallas for big segments,
+    XLA scan fallback below the 1024-lane grid minimum (shape decision is
+    static at trace time)."""
+
+    def impl(words):
+        if words.shape[0] % 1024 == 0:
+            return segment_keccak_pallas(words, interpret=interpret)
+        from .keccak_staged import _segment_keccak
+
+        return _segment_keccak(words)
+
+    return impl

@@ -49,6 +49,9 @@ class VMConfig:
     commit_interval: int = 4096
     mempool_size: int = 4096
     clock: Optional[object] = None
+    # "auto"/"batched": drain large dirty sets to the device keccak from
+    # Trie.hash (trie/trie.go:618-619 parallel-threshold analog); "off": CPU
+    device_hasher: str = "auto"
 
 
 @dataclass
