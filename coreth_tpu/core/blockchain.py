@@ -37,6 +37,10 @@ class CacheConfig:
     trie_dirty_limit: int = 256 * 1024 * 1024
     accepted_cache_size: int = 32
     snapshot_limit: int = 0  # 0 disables the flat snapshot (Phase 4)
+    # "auto"/"batched": Trie.hash drains dirty sets >= BATCH_THRESHOLD to the
+    # device keccak (trie/trie.go:618-619 parallel-threshold analog); "off":
+    # recursive CPU hasher everywhere.
+    device_hasher: str = "auto"
 
 
 class BlockValidator:
@@ -96,7 +100,12 @@ class BlockChain:
         self.config = config
         self.engine = engine
         if state_database is None:
-            state_database = Database(TrieDatabase(diskdb))
+            from ..ops.device import get_batch_keccak
+
+            state_database = Database(TrieDatabase(
+                diskdb,
+                batch_keccak=get_batch_keccak(cache_config.device_hasher),
+            ))
         self.state_database = state_database
 
         self.chainmu = threading.RLock()

@@ -32,6 +32,11 @@ class BlockGen:
         self.chain = chain
 
         self.header = _make_header(config, chain, parent, statedb, engine, gap)
+        # mirror the miner's CheckConfigurePrecompiles so generated blocks
+        # carry the same activation state the processor will recompute
+        config.check_configure_precompiles(
+            parent.header.time, self.header, statedb
+        )
         self.txs: List[Transaction] = []
         self.receipts: List[Receipt] = []
         self.gas_pool = GasPool(self.header.gas_limit)

@@ -58,6 +58,12 @@ class Genesis:
                 statedb.set_state(addr, k, v)
             for coin, amt in acct.mc_balances.items():
                 statedb.add_balance_multicoin(addr, coin, amt)
+        if self.config is not None:
+            # genesis-activated precompiles configure the starting state
+            # (genesis.go:269: parent timestamp None)
+            self.config.check_configure_precompiles(
+                None, Header(number=0, time=self.timestamp), statedb
+            )
         root = statedb.commit(False)
 
         base_fee = self.base_fee

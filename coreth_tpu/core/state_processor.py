@@ -98,6 +98,10 @@ class StateProcessor:
         gp = GasPool(header.gas_limit)
         receipts: list = []
 
+        # activate any stateful precompile whose fork falls in this
+        # transition (state_processor.go:80)
+        self.config.check_configure_precompiles(parent.time, header, statedb)
+
         block_ctx = new_block_context(header, self.chain)
         evm = EVM(block_ctx, TxContext(), statedb, self.config, vm_config or Config())
 

@@ -96,6 +96,10 @@ class Worker:
 
         statedb = self.chain.state_at(parent.root)
 
+        # CheckConfigurePrecompiles (miner/worker.go:170): the block being
+        # built must see precompiles activated by its own timestamp
+        self.config.check_configure_precompiles(parent.header.time, header, statedb)
+
         if pending is None:
             pending = self.tx_pool.pending_txs() if self.tx_pool is not None else {}
 

@@ -22,24 +22,34 @@ _cached: dict = {}
 def get_batch_keccak(mode: str = "auto") -> Optional[Callable]:
     """Resolve a `list[bytes] -> list[bytes32]` batched keccak, or None.
 
-    mode: "auto" | "batched" — device-batched hashing (same callable; auto
-          exists so config files can distinguish "default" from "forced")
-          "off" — None (CPU recursive hasher everywhere)
+    mode: "auto"    — device-batched hashing when the backend resolves,
+                      silent CPU fallback otherwise
+          "batched" — same callable, but unavailability is an error: the
+                      operator forced the device path, so degrading quietly
+                      would hide a node-wide throughput regression
+          "off"     — None (CPU recursive hasher everywhere)
     """
     if mode == "off":
         return None
     if mode not in ("auto", "batched"):
         raise ValueError(f"unknown device-hasher mode {mode!r}")
-    if "fn" in _cached:
-        return _cached["fn"]
-    try:
-        from ..utils import enable_compilation_cache
+    if "fn" not in _cached:
+        try:
+            from ..utils import enable_compilation_cache
 
-        enable_compilation_cache()
-        from .keccak_jax import BatchedKeccak
+            enable_compilation_cache()
+            from .keccak_jax import BatchedKeccak
 
-        fn = BatchedKeccak().digests
-    except Exception:
-        fn = None
-    _cached["fn"] = fn
-    return fn
+            _cached["fn"] = BatchedKeccak().digests
+        except Exception as e:  # fail-soft is only legal for "auto"
+            import warnings
+
+            warnings.warn(f"device keccak unavailable, chain runs CPU-only: {e!r}")
+            _cached["fn"] = None
+            _cached["error"] = e
+    if _cached["fn"] is None and mode == "batched":
+        raise RuntimeError(
+            "device-hasher forced to 'batched' but the device keccak failed "
+            f"to resolve: {_cached.get('error')!r}"
+        )
+    return _cached["fn"]

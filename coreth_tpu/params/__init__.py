@@ -89,6 +89,30 @@ class ChainConfig:
     cortina_time: Optional[int] = None
     d_upgrade_time: Optional[int] = None
 
+    # Stateful-precompile registrations (precompile/ framework): configs
+    # with .address/.timestamp/.is_activated/.configure/.contract —
+    # reference params/config.go:1027-1101
+    precompile_upgrades: tuple = ()
+
+    # ---- stateful precompiles -------------------------------------------
+
+    def enabled_stateful_precompiles(self):
+        """Configs in activation order (config.go:1082-1089)."""
+        return sorted(
+            (c for c in self.precompile_upgrades if c.timestamp is not None),
+            key=lambda c: c.timestamp,
+        )
+
+    def check_configure_precompiles(self, parent_ts: Optional[int],
+                                    block_header, statedb) -> None:
+        """Activate any precompile whose timestamp falls in the
+        parent->block transition (config.go:1092-1101); called from the
+        processor, the miner, and genesis construction."""
+        from ..precompile import check_configure
+
+        for cfg in self.enabled_stateful_precompiles():
+            check_configure(self, parent_ts, block_header, cfg, statedb)
+
     # ---- per-block fork checks ------------------------------------------
 
     def _is_block(self, fork: Optional[int], number: int) -> bool:
@@ -140,6 +164,11 @@ class ChainConfig:
             is_banff=self.is_banff(timestamp),
             is_cortina=self.is_cortina(timestamp),
             is_d_upgrade=self.is_d_upgrade(timestamp),
+            active_precompiles={
+                cfg.address: cfg.contract()
+                for cfg in self.precompile_upgrades
+                if cfg.is_activated(timestamp)
+            },
         )
 
 

@@ -1,0 +1,167 @@
+"""The `tpu_keccak` stateful precompile — BASELINE config #5.
+
+Contracts submit a batch of byte strings and get their Keccak-256
+digests back in one call, priced per message at the EVM's own SHA3
+schedule (gas.py KECCAK256_GAS/WORD_GAS) plus a flat batch base.
+
+Backend choice is NOT consensus-relevant (digests are bit-identical on
+every backend), so it never appears in chain config: the contract
+resolves the node's device keccak lazily ("auto" — the same handle the
+trie commit path uses) and falls back to the threaded C++ host keccak
+on any device-side failure. Gas is charged from the ABI lengths BEFORE
+any message bytes are materialized, so a caller cannot buy cheap memory
+amplification with overlapping offsets.
+
+No analog exists in the reference (its precompile/ framework ships no
+keccak precompile); the surface is new, registered through the same
+config/activation machinery as reference stateful precompiles
+(stateful_precompile_config.go:13-56).
+
+ABI (solidity):
+    function keccak256Batch(bytes[] calldata msgs)
+        external view returns (bytes32[] memory digests);
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import cached_property
+from typing import List
+
+from .. import vmerrs
+from ..crypto import keccak256_batch
+from ..evm.gas import KECCAK256_GAS, KECCAK256_WORD_GAS
+
+TPU_KECCAK_ADDR = bytes.fromhex("0100000000000000000000000000000000000010")
+
+# flat cost of entering the precompile (dispatch + ABI walk); per-message
+# costs then follow the SHA3 opcode schedule so on-chain pricing is
+# familiar: 30 + 6*ceil(len/32) per message (evm/gas.py:19-20)
+BATCH_BASE_GAS = 2000
+
+# messages per call cap: bounds ABI-decode work and device batch size
+MAX_BATCH_MESSAGES = 65536
+
+# device path engages above this many messages; below it the threaded
+# C++ keccak wins (same threshold spirit as trie/hasher.BATCH_THRESHOLD)
+DEVICE_THRESHOLD = 64
+
+_WORD = 32
+
+
+def _u256(data: bytes, off: int) -> int:
+    if off + _WORD > len(data):
+        raise vmerrs.ErrPrecompileFailure
+    return int.from_bytes(data[off:off + _WORD], "big")
+
+
+def scan_bytes_array(args: bytes) -> List[int]:
+    """Walk the ABI `bytes[]` layout returning (start, len) anchors WITHOUT
+    copying message bytes — the gas base for charge-before-materialize."""
+    head = _u256(args, 0)
+    count = _u256(args, head)
+    if count > MAX_BATCH_MESSAGES:
+        raise vmerrs.ErrPrecompileFailure
+    base = head + _WORD
+    anchors = []
+    for i in range(count):
+        rel = _u256(args, base + i * _WORD)
+        mlen = _u256(args, base + rel)
+        start = base + rel + _WORD
+        if start + mlen > len(args):
+            raise vmerrs.ErrPrecompileFailure
+        anchors.append((start, mlen))
+    return anchors
+
+
+def decode_bytes_array(args: bytes) -> List[bytes]:
+    """ABI-decode `bytes[]` (selector already stripped)."""
+    return [args[s:s + n] for s, n in scan_bytes_array(args)]
+
+
+def encode_bytes32_array(vals: List[bytes]) -> bytes:
+    """ABI-encode `bytes32[]` return data."""
+    out = bytearray()
+    out += (_WORD).to_bytes(_WORD, "big")        # offset to array
+    out += len(vals).to_bytes(_WORD, "big")      # length
+    for v in vals:
+        out += v
+    return bytes(out)
+
+
+def _per_msg_gas(length: int) -> int:
+    return KECCAK256_GAS + KECCAK256_WORD_GAS * ((length + 31) // 32)
+
+
+def batch_gas(msgs: List[bytes]) -> int:
+    return BATCH_BASE_GAS + sum(_per_msg_gas(len(m)) for m in msgs)
+
+
+class _Hasher:
+    """Lazy device-resolving batch hasher; ALWAYS returns digests.
+
+    Any device-side failure (backend missing, XLA error, OOM) falls back
+    to the C++ host keccak — identical digests, so a node-local hardware
+    problem can never turn into a consensus split mid-transaction."""
+
+    def __init__(self):
+        self._device = None
+        self._resolved = False
+
+    def __call__(self, msgs: List[bytes]) -> List[bytes]:
+        if len(msgs) >= DEVICE_THRESHOLD:
+            if not self._resolved:
+                try:
+                    from ..ops.device import get_batch_keccak
+
+                    self._device = get_batch_keccak("auto")
+                except Exception:
+                    self._device = None
+                self._resolved = True
+            if self._device is not None:
+                try:
+                    return self._device(msgs)
+                except Exception:
+                    pass  # fall through to the host path
+        return keccak256_batch(msgs, threads=0 if len(msgs) < 256 else 8)
+
+
+from . import PrecompileConfig  # noqa: E402  (no cycle: package defines it first)
+
+
+@dataclass(frozen=True)
+class TpuKeccakConfig(PrecompileConfig):
+    """Activation config: framework semantics inherited from
+    PrecompileConfig; this class only picks the address default and
+    builds the contract."""
+
+    address: bytes = TPU_KECCAK_ADDR
+
+    @cached_property
+    def _contract(self):
+        from . import (PrecompileFunction, SelectorDispatchContract,
+                       charge_gas, function_selector)
+
+        hasher = _Hasher()
+
+        def run_batch(evm, caller, addr, args, gas, read_only):
+            try:
+                anchors = scan_bytes_array(args)
+            except vmerrs.VMError:
+                raise
+            except Exception:
+                raise vmerrs.ErrPrecompileFailure
+            cost = BATCH_BASE_GAS + sum(_per_msg_gas(n) for _, n in anchors)
+            gas = charge_gas(gas, cost)
+            msgs = [args[s:s + n] for s, n in anchors]
+            digests = hasher(msgs)
+            return encode_bytes32_array(list(digests)), gas
+
+        return SelectorDispatchContract([
+            PrecompileFunction(
+                function_selector("keccak256Batch(bytes[])"), run_batch
+            ),
+        ])
+
+    def contract(self):
+        return self._contract

@@ -55,6 +55,10 @@ class Config:
     offline_pruning_bloom_filter_size: int = 512   # MB
     offline_pruning_data_directory: str = ""
 
+    # --- device hashing ---------------------------------------------------
+    # "auto": large dirty sets drain to the device keccak; "off": CPU only
+    device_hasher: str = "auto"
+
     # --- tx pool ----------------------------------------------------------
     local_txs_enabled: bool = False
     tx_pool_price_limit: int = 1
@@ -114,6 +118,8 @@ class Config:
                 f"state sync commit interval ({self.state_sync_commit_interval}) "
                 f"must be a multiple of commit interval ({self.commit_interval})"
             )
+        if self.device_hasher not in ("auto", "batched", "off"):
+            raise ValueError(f"unknown device-hasher mode {self.device_hasher!r}")
 
 
 def parse_config(config_bytes: bytes) -> Config:

@@ -95,6 +95,7 @@ class VM:
                 pruning=full.pruning_enabled,
                 commit_interval=full.commit_interval,
                 mempool_size=full.tx_pool_global_slots,
+                device_hasher=full.device_hasher,
             )
         else:
             from .config import Config as FullConfig
@@ -121,12 +122,17 @@ class VM:
         )
         self.engine = DummyEngine(cb)
 
-        self.state_database = Database(TrieDatabase(diskdb))
+        from ..ops.device import get_batch_keccak
+
+        self.state_database = Database(TrieDatabase(
+            diskdb, batch_keccak=get_batch_keccak(self.config.device_hasher)
+        ))
         self.blockchain = BlockChain(
             diskdb,
             CacheConfig(
                 pruning=self.config.pruning,
                 commit_interval=self.config.commit_interval,
+                device_hasher=self.config.device_hasher,
             ),
             self.chain_config,
             genesis,
@@ -156,7 +162,10 @@ class VM:
         # atomic ops index with interval commits (atomic_trie.go)
         from .atomic_trie import AtomicTrie
 
-        self.atomic_trie = AtomicTrie(diskdb, self.config.commit_interval)
+        self.atomic_trie = AtomicTrie(
+            diskdb, self.config.commit_interval,
+            batch_keccak=get_batch_keccak(self.config.device_hasher),
+        )
 
         self._verified_blocks: Dict[bytes, VMBlock] = {}
         self._accepted_atomic_ops: List = []

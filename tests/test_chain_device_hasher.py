@@ -1,0 +1,123 @@
+"""Chain-level shadow run: the production insert/accept path drains its
+trie hashing to the device batch keccak, and every block root is
+bit-identical to a CPU-recursive-hasher shadow chain.
+
+Reference seam being validated: trie/trie.go:618-619 engages the parallel
+hasher automatically from the hot path when >=100 nodes are unhashed; here
+Trie.hash() engages BatchedHasher(batch_keccak) above BATCH_THRESHOLD.
+The batch_keccak handle flows VM/BlockChain -> TrieDatabase -> StateTrie
+-> Trie (core/blockchain.go:99 / vm/vm.py plumbing added for VERDICT #3).
+"""
+
+import pytest
+
+from coreth_tpu import params
+from coreth_tpu.consensus.dummy import new_dummy_engine
+from coreth_tpu.core.blockchain import BlockChain, CacheConfig
+from coreth_tpu.core.chain_makers import generate_chain
+from coreth_tpu.core.genesis import Genesis, GenesisAccount
+from coreth_tpu.core.types import Signer, Transaction
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.state.database import Database
+from coreth_tpu.trie.hasher import BATCH_THRESHOLD
+from coreth_tpu.trie.triedb import TrieDatabase
+
+# enough senders that every block dirties >= BATCH_THRESHOLD trie nodes,
+# so the chain path actually crosses into the batched-device hasher
+N_SENDERS = 120
+
+KEYS = [i.to_bytes(1, "big") * 32 for i in range(1, N_SENDERS + 1)]
+ADDRS = [priv_to_address(k) for k in KEYS]
+FUND = 10**21
+
+
+class CountingKeccak:
+    """Wraps the device batch keccak, counting drains + hashed messages."""
+
+    def __init__(self):
+        from coreth_tpu.ops.keccak_jax import BatchedKeccak
+
+        self._inner = BatchedKeccak().digests
+        self.calls = 0
+        self.msgs = 0
+
+    def __call__(self, msgs):
+        self.calls += 1
+        self.msgs += len(msgs)
+        return self._inner(msgs)
+
+
+def make_chain(batch_keccak):
+    cfg = params.TEST_CHAIN_CONFIG
+    diskdb = MemoryDB()
+    state_db = Database(TrieDatabase(diskdb, batch_keccak=batch_keccak))
+    genesis = Genesis(
+        config=cfg,
+        gas_limit=params.CORTINA_GAS_LIMIT,
+        alloc={a: GenesisAccount(balance=FUND) for a in ADDRS},
+    )
+    chain = BlockChain(
+        diskdb,
+        CacheConfig(pruning=True),
+        cfg,
+        genesis,
+        new_dummy_engine(),
+        state_database=state_db,
+    )
+    return chain
+
+
+def transfer_tx(nonce, to, key, base_fee):
+    tx = Transaction(
+        type=2, chain_id=43112, nonce=nonce, max_fee=base_fee * 2,
+        max_priority_fee=0, gas=21000, to=to, value=1000,
+    )
+    return Signer(43112).sign(tx, key)
+
+
+def test_chain_insert_accept_device_hasher_shadow():
+    counter = CountingKeccak()
+    device_chain = make_chain(counter)
+    shadow_chain = make_chain(None)  # recursive CPU hasher everywhere
+
+    base_fee = params.APRICOT_PHASE3_INITIAL_BASE_FEE
+
+    def gen(i, bg):
+        bf = bg.base_fee() or base_fee
+        for j, key in enumerate(KEYS):
+            # each sender pays a distinct recipient: 2*N dirty accounts/block
+            to = (0x5000 + i * N_SENDERS + j).to_bytes(20, "big")
+            bg.add_tx(transfer_tx(i, to, key, bf))
+
+    # device chain generates (its hasher computed every header root)...
+    blocks, _ = generate_chain(
+        device_chain.config, device_chain.current_block, device_chain.engine,
+        device_chain.state_database, 2, gen=gen,
+    )
+    assert counter.calls > 0, "BATCH_THRESHOLD never crossed: grow the block"
+    assert counter.msgs >= BATCH_THRESHOLD
+
+    # ...and both chains must verify + accept the same blocks: the shadow
+    # chain's validate_state recomputes every root with the CPU hasher, so
+    # acceptance IS the bit-exactness assertion.
+    for chain in (device_chain, shadow_chain):
+        for b in blocks:
+            chain.insert_block(b)
+            chain.accept(b)
+        chain.drain_acceptor_queue()
+
+    assert device_chain.current_block.hash() == shadow_chain.current_block.hash()
+    assert device_chain.current_block.root == shadow_chain.current_block.root
+
+
+def test_vm_config_device_hasher_knob():
+    """The JSON knob parses and validates (config.go-style)."""
+    from coreth_tpu.vm.config import parse_config
+
+    cfg = parse_config(b'{"device-hasher": "off"}')
+    assert cfg.device_hasher == "off"
+    cfg = parse_config(b"{}")
+    assert cfg.device_hasher == "auto"
+    with pytest.raises(ValueError):
+        parse_config(b'{"device-hasher": "warp"}')

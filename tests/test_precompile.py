@@ -1,0 +1,335 @@
+"""Stateful-precompile framework + tpu_keccak precompile tests
+(reference surfaces: precompile/stateful_precompile_config.go:13-56,
+precompile/contract.go:17-141, params/config.go:1027-1101)."""
+
+import pytest
+
+from coreth_tpu import params, vmerrs
+from coreth_tpu.core.types import Header
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.evm.evm import EVM, BlockContext, TxContext
+from coreth_tpu.native import keccak256
+from coreth_tpu.precompile import (
+    SELECTOR_LEN,
+    PrecompileConfig,
+    PrecompileFunction,
+    SelectorDispatchContract,
+    TPU_KECCAK_ADDR,
+    TpuKeccakConfig,
+    check_configure,
+    function_selector,
+    is_fork_transition,
+)
+from coreth_tpu.precompile.tpu_keccak import (
+    batch_gas,
+    decode_bytes_array,
+    encode_bytes32_array,
+)
+from coreth_tpu.state.database import Database
+from coreth_tpu.state.statedb import StateDB
+from coreth_tpu.trie.node import EMPTY_ROOT
+from coreth_tpu.trie.triedb import TrieDatabase
+
+CALLER = b"\xcc" * 20
+SEL = function_selector("keccak256Batch(bytes[])")
+
+
+def fresh_state():
+    return StateDB(EMPTY_ROOT, Database(TrieDatabase(MemoryDB())))
+
+
+def chain_config(activation_ts):
+    import dataclasses
+
+    return dataclasses.replace(
+        params.TEST_CHAIN_CONFIG,
+        precompile_upgrades=(TpuKeccakConfig(timestamp=activation_ts),),
+    )
+
+
+def abi_pack_batch(msgs):
+    from coreth_tpu.accounts.abi import ABI
+
+    abi = ABI([{
+        "type": "function", "name": "keccak256Batch",
+        "inputs": [{"name": "msgs", "type": "bytes[]"}],
+        "outputs": [{"name": "digests", "type": "bytes32[]"}],
+    }])
+    return abi
+
+
+# --- framework ------------------------------------------------------------
+
+
+class TestForkTransition:
+    def test_truth_table(self):
+        # (fork, parent, current) -> activates now
+        assert is_fork_transition(0, None, 0)
+        assert is_fork_transition(5, None, 5)
+        assert is_fork_transition(5, 4, 5)
+        assert not is_fork_transition(None, None, 100)
+        assert not is_fork_transition(5, None, 4)      # not yet
+        assert not is_fork_transition(5, 5, 6)         # already active
+        assert not is_fork_transition(5, 7, 9)         # long active
+        assert not is_fork_transition(10, 4, 9)        # still pending
+
+
+class TestCheckConfigure:
+    def test_marks_address_and_seeds_state(self):
+        seeded = []
+
+        class Cfg(PrecompileConfig):
+            def configure(self, chain_config, statedb, header):
+                seeded.append(header.time)
+                statedb.set_state(self.address, b"\x00" * 32, b"\x77" * 32)
+
+        cfg = Cfg(address=b"\x01" * 20, timestamp=100)
+        state = fresh_state()
+        # transition block activates: nonce=1, code=0x01 (so Solidity
+        # extcodesize guards pass), configure ran
+        check_configure(None, 50, Header(time=100), cfg, state)
+        assert state.get_nonce(cfg.address) == 1
+        assert state.get_code(cfg.address) == b"\x01"
+        assert state.get_state(cfg.address, b"\x00" * 32) == b"\x77" * 32
+        assert seeded == [100]
+        # later blocks do NOT re-run configure
+        check_configure(None, 100, Header(time=200), cfg, state)
+        assert seeded == [100]
+
+    def test_chain_config_walks_registrations(self):
+        cfg = chain_config(activation_ts=100)
+        state = fresh_state()
+        cfg.check_configure_precompiles(None, Header(time=99), state)
+        assert state.get_code(TPU_KECCAK_ADDR) == b""
+        cfg.check_configure_precompiles(99, Header(time=100), state)
+        assert state.get_code(TPU_KECCAK_ADDR) == b"\x01"
+        assert state.get_nonce(TPU_KECCAK_ADDR) == 1
+
+
+class TestSelectorDispatch:
+    def _contract(self):
+        def echo(evm, caller, addr, args, gas, read_only):
+            return b"echo:" + args, gas - 1
+
+        def fb(evm, caller, addr, args, gas, read_only):
+            return b"fallback", gas
+
+        return SelectorDispatchContract(
+            [PrecompileFunction(b"\x01\x02\x03\x04", echo)], fallback=fb
+        )
+
+    def test_dispatch_and_fallback(self):
+        c = self._contract()
+        ret, gas = c.run(None, CALLER, b"\x00" * 20, b"\x01\x02\x03\x04hi", 100, False)
+        assert ret == b"echo:hi" and gas == 99
+        ret, gas = c.run(None, CALLER, b"\x00" * 20, b"", 100, False)
+        assert ret == b"fallback"
+
+    def test_unknown_and_short_selector_fail_plain(self):
+        c = self._contract()
+        with pytest.raises(vmerrs.VMError):
+            c.run(None, CALLER, b"\x00" * 20, b"\xde\xad\xbe\xef", 100, False)
+        with pytest.raises(vmerrs.VMError):
+            c.run(None, CALLER, b"\x00" * 20, b"\x01\x02", 100, False)
+
+    def test_duplicate_selector_rejected(self):
+        fn = PrecompileFunction(b"\x01\x02\x03\x04", lambda *a: (b"", 0))
+        with pytest.raises(ValueError):
+            SelectorDispatchContract([fn, fn])
+
+    def test_function_selector_known_vector(self):
+        # keccak("transfer(address,uint256)")[:4] == a9059cbb (universal ERC-20)
+        assert function_selector("transfer(address,uint256)").hex() == "a9059cbb"
+        with pytest.raises(ValueError):
+            function_selector("not a signature")
+
+
+# --- tpu_keccak ABI + gas -------------------------------------------------
+
+
+class TestTpuKeccakCodec:
+    def test_decode_matches_abi_oracle(self):
+        abi = abi_pack_batch(None)
+        msgs = [b"", b"abc", b"x" * 100, b"y" * 200]
+        packed = abi.pack("keccak256Batch", msgs)
+        assert packed[:SELECTOR_LEN] == SEL
+        assert decode_bytes_array(packed[SELECTOR_LEN:]) == msgs
+
+    def test_encode_matches_abi_oracle(self):
+        abi = abi_pack_batch(None)
+        digests = [keccak256(m) for m in (b"", b"abc", b"zz")]
+        enc = encode_bytes32_array(digests)
+        assert abi.unpack("keccak256Batch", enc) == [digests]
+
+    def test_malformed_input_raises(self):
+        with pytest.raises(vmerrs.VMError):
+            decode_bytes_array(b"\x00" * 16)  # truncated head
+        # offset pointing past the end
+        bad = (64).to_bytes(32, "big") + (10**9).to_bytes(32, "big")
+        with pytest.raises(vmerrs.VMError):
+            decode_bytes_array(bad)
+
+    def test_gas_schedule(self):
+        from coreth_tpu.precompile.tpu_keccak import BATCH_BASE_GAS
+
+        assert batch_gas([]) == BATCH_BASE_GAS
+        # one 33-byte msg: 30 + 6*2
+        assert batch_gas([b"z" * 33]) == BATCH_BASE_GAS + 30 + 12
+
+
+# --- end-to-end through the EVM ------------------------------------------
+
+
+def make_evm(cfg, time, state=None):
+    state = state or fresh_state()
+    bctx = BlockContext(block_number=1, time=time, base_fee=None)
+    return EVM(bctx, TxContext(origin=CALLER, gas_price=1), state, cfg)
+
+
+class TestTpuKeccakEVM:
+    def test_pre_activation_not_dispatched(self):
+        cfg = chain_config(activation_ts=1000)
+        evm = make_evm(cfg, time=999)
+        assert TPU_KECCAK_ADDR not in evm.precompiles
+
+    def test_post_activation_call_returns_digests(self):
+        cfg = chain_config(activation_ts=1000)
+        state = fresh_state()
+        cfg.check_configure_precompiles(999, Header(time=1000), state)
+        evm = make_evm(cfg, time=1000, state=state)
+        assert TPU_KECCAK_ADDR in evm.precompiles
+
+        abi = abi_pack_batch(None)
+        msgs = [b"", b"abc", b"hello world", b"q" * 500]
+        input_ = abi.pack("keccak256Batch", msgs)
+        ret, gas_left, err = evm.call(CALLER, TPU_KECCAK_ADDR, input_, 100_000, 0)
+        assert err is None
+        (digests,) = abi.unpack("keccak256Batch", ret)
+        assert digests == [keccak256(m) for m in msgs]
+        spent = 100_000 - gas_left
+        assert spent == batch_gas(msgs)
+
+    def test_out_of_gas_burns(self):
+        cfg = chain_config(activation_ts=0)
+        state = fresh_state()
+        cfg.check_configure_precompiles(None, Header(time=0), state)
+        evm = make_evm(cfg, time=0, state=state)
+        abi = abi_pack_batch(None)
+        input_ = abi.pack("keccak256Batch", [b"x" * 64])
+        ret, gas_left, err = evm.call(CALLER, TPU_KECCAK_ADDR, input_, 100, 0)
+        assert err is not None
+        assert gas_left == 0  # plain failure burns all remaining gas
+
+    def test_genesis_activation_seeds_code(self):
+        from coreth_tpu.core.genesis import Genesis
+
+        cfg = chain_config(activation_ts=0)
+        db = Database(TrieDatabase(MemoryDB()))
+        g = Genesis(config=cfg, gas_limit=8_000_000, alloc={})
+        block = g.to_block(db)
+        state = StateDB(block.root, db)
+        assert state.get_code(TPU_KECCAK_ADDR) == b"\x01"
+        assert state.get_nonce(TPU_KECCAK_ADDR) == 1
+
+
+class TestMidChainActivation:
+    """Activation crossed mid-chain: generated blocks, processor
+    verification, and a contract call against accepted state all agree."""
+
+    def test_activation_and_call_through_chain(self):
+        import dataclasses
+
+        from coreth_tpu.consensus.dummy import new_dummy_engine
+        from coreth_tpu.core.blockchain import BlockChain, CacheConfig
+        from coreth_tpu.core.chain_makers import generate_chain
+        from coreth_tpu.core.genesis import Genesis, GenesisAccount
+        from coreth_tpu.core.types import Signer, Transaction
+        from coreth_tpu.crypto.secp256k1 import priv_to_address
+
+        key = b"\x11" * 32
+        addr = priv_to_address(key)
+
+        diskdb = MemoryDB()
+        db = Database(TrieDatabase(diskdb))
+        genesis_ts = 0
+        # activates at genesis_ts + 15: with gap=10 block1 is pre, block2 post
+        cfg = dataclasses.replace(
+            params.TEST_CHAIN_CONFIG,
+            precompile_upgrades=(TpuKeccakConfig(timestamp=15),),
+        )
+        genesis = Genesis(config=cfg, gas_limit=params.CORTINA_GAS_LIMIT,
+                          alloc={addr: GenesisAccount(balance=10**21)})
+        chain = BlockChain(diskdb, CacheConfig(pruning=False), cfg, genesis,
+                           new_dummy_engine(), state_database=db)
+
+        abi = abi_pack_batch(None)
+        msgs = [b"alpha", b"beta" * 50]
+        calldata = abi.pack("keccak256Batch", msgs)
+
+        def gen(i, bg):
+            if i == 1:
+                bf = bg.base_fee() or params.APRICOT_PHASE3_INITIAL_BASE_FEE
+                tx = Transaction(
+                    type=2, chain_id=43112, nonce=0, max_fee=bf * 2,
+                    max_priority_fee=0, gas=100_000, to=TPU_KECCAK_ADDR,
+                    value=0, data=calldata,
+                )
+                bg.add_tx(Signer(43112).sign(tx, key))
+
+        blocks, receipts = generate_chain(
+            cfg, chain.current_block, chain.engine, db, 2, gen=gen,
+        )
+        # block 1 (time=10): pre-activation; block 2 (time=20): active
+        for b in blocks:
+            chain.insert_block(b)
+            chain.accept(b)
+        chain.drain_acceptor_queue()
+
+        state = chain.state_at(blocks[1].root)
+        assert state.get_code(TPU_KECCAK_ADDR) == b"\x01"
+        # the tx in block 2 called the precompile successfully
+        assert receipts[1][0].status == 1
+        intrinsic = 21_000 + sum(
+            (4 if b == 0 else 16) for b in calldata
+        )
+        assert receipts[1][0].gas_used == intrinsic + batch_gas(msgs)
+        # pre-activation state has no account
+        state1 = chain.state_at(blocks[0].root)
+        assert state1.get_code(TPU_KECCAK_ADDR) == b""
+
+
+class TestGasBeforeMaterialize:
+    def test_overlapping_offsets_charge_before_copy(self):
+        """8192 elements all aliasing one big region must hit OutOfGas from
+        the length scan alone — no message bytes may be materialized."""
+        import time
+
+        cfg = chain_config(activation_ts=0)
+        c = cfg.precompile_upgrades[0].contract()
+        blob = b"\xab" * (1 << 20)  # 1 MiB
+        n = 8192
+        head = (32).to_bytes(32, "big")
+        count = n.to_bytes(32, "big")
+        # every element offset points at the same (len || data) record
+        rel = (n * 32).to_bytes(32, "big")
+        args = head + count + rel * n + len(blob).to_bytes(32, "big") + blob
+        t0 = time.perf_counter()
+        with pytest.raises(vmerrs.VMError) as ei:
+            c.run(None, CALLER, TPU_KECCAK_ADDR, SEL + args, 10_000_000, False)
+        assert "out of gas" in str(ei.value)
+        # scanning 8k anchors is microseconds; copying 8 GiB is not
+        assert time.perf_counter() - t0 < 1.0
+
+    def test_device_failure_falls_back_to_host(self, monkeypatch):
+        from coreth_tpu.precompile import tpu_keccak as tk
+
+        h = tk._Hasher()
+
+        def boom(msgs):
+            raise RuntimeError("device lost")
+
+        h._device = boom
+        h._resolved = True
+        msgs = [b"m%d" % i for i in range(tk.DEVICE_THRESHOLD)]
+        digs = h(msgs)
+        assert digs == [keccak256(m) for m in msgs]
