@@ -38,17 +38,28 @@ class TxSenderCacher:
             self._futures.append(fut)
             return
 
-        def work(chunk):
-            for tx in chunk:
-                try:
-                    signer.sender(tx)  # caches tx._sender
-                except Exception:
-                    pass
+        def work_batch(chunk):
+            try:
+                signer.sender_batch(chunk)  # native batched recovery
+            except Exception:
+                for tx in chunk:
+                    try:
+                        signer.sender(tx)
+                    except Exception:
+                        pass
 
-        # strided split like the reference (sender_cacher.go:100-108)
-        n = min(4, len(txs))
-        for i in range(n):
-            self._futures.append(self._pool.submit(work, txs[i::n]))
+        from ..native import secp
+
+        if secp.available():
+            # ONE native call: the C++ side threads internally; a strided
+            # split would just multiply thread-spawn waves
+            self._futures.append(self._pool.submit(work_batch, txs))
+        else:
+            # pure-Python path: strided split like the reference
+            # (sender_cacher.go:100-108) so the pool overlaps work
+            n = min(4, len(txs))
+            for i in range(n):
+                self._futures.append(self._pool.submit(work_batch, txs[i::n]))
 
     def recover_from_block(self, signer: Signer, block) -> None:
         self.recover(signer, block.transactions)

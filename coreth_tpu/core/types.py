@@ -226,20 +226,7 @@ class Signer:
     def sender(self, tx: Transaction) -> bytes:
         if tx._sender is not None:
             return tx._sender
-        protected = True
-        if tx.type == LEGACY_TX_TYPE:
-            if tx.v >= 35:
-                chain_id = (tx.v - 35) // 2
-                if chain_id != self.chain_id:
-                    raise ValueError("invalid chain id for signer")
-                recid = (tx.v - 35) % 2
-            else:
-                protected = False
-                recid = tx.v - 27
-        else:
-            if (tx.chain_id or 0) != self.chain_id:
-                raise ValueError("invalid chain id for signer")
-            recid = tx.v
+        recid, protected = self._recid_of(tx)
         addr = secp256k1.recover_address(
             self.sig_hash(tx, protected=protected), recid, tx.r, tx.s
         )
@@ -247,6 +234,50 @@ class Signer:
             raise ValueError("invalid signature")
         tx._sender = addr
         return addr
+
+    def _recid_of(self, tx: Transaction):
+        """(recid, protected) per the sender() rules; raises on bad chain id."""
+        if tx.type == LEGACY_TX_TYPE:
+            if tx.v >= 35:
+                if (tx.v - 35) // 2 != self.chain_id:
+                    raise ValueError("invalid chain id for signer")
+                return (tx.v - 35) % 2, True
+            return tx.v - 27, False
+        if (tx.chain_id or 0) != self.chain_id:
+            raise ValueError("invalid chain id for signer")
+        return tx.v, True
+
+    def sender_batch(self, txs) -> None:
+        """Batch-recover senders into each tx's cache — the sender-cacher
+        drain (core/sender_cacher.go:88-115). Uses the native batched
+        secp256k1 when available; silently leaves invalid txs uncached so
+        the per-tx sender() surfaces the precise error later."""
+        from ..native import secp
+
+        todo = [tx for tx in txs if tx._sender is None]
+        if not todo:
+            return
+        if not secp.available():
+            for tx in todo:
+                try:
+                    self.sender(tx)
+                except Exception:
+                    pass
+            return
+        items = []
+        ok_idx = []
+        for i, tx in enumerate(todo):
+            try:
+                recid, protected = self._recid_of(tx)
+            except Exception:
+                continue
+            items.append((self.sig_hash(tx, protected=protected),
+                          recid, tx.r, tx.s))
+            ok_idx.append(i)
+        addrs = secp.recover_batch(items)
+        for i, addr in zip(ok_idx, addrs):
+            if addr is not None:
+                todo[i]._sender = addr
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,169 @@
+"""Persistent key-value backend over SQLite (role of the reference's
+/root/reference/ethdb/pebble/pebble.go and ethdb/leveldb/leveldb.go).
+
+Why SQLite and not a hand-rolled LSM: the reference's requirement at L0
+is a crash-safe ordered KV store with atomic write batches
+(ethdb/database.go + ethdb/batch.go contract, exercised by
+ethdb/dbtest/testsuite.go). SQLite's B-tree with WAL journaling gives
+all three (memcmp-ordered BLOB primary keys, transactional batches,
+fsync discipline) from the Python stdlib — no native build step on the
+chain-startup path, while the heavy state work stays on the device path.
+
+Contract details matched to the reference backends:
+  - keys are raw bytes, ordered bytewise (BLOB PRIMARY KEY is memcmp
+    order, same as pebble/leveldb iterators)
+  - write_batch applies atomically: all-or-nothing across crash
+    (pebble.Batch.Commit / leveldb.Batch.Write)
+  - iterate(prefix, start) = NewIterator(prefix, start): ascending from
+    prefix+start, bounded to the prefix
+  - close() is idempotent; operations after close raise (database.go
+    ErrClosed semantics)
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+from . import KeyValueStore
+
+_ITER_CHUNK = 1024
+
+
+class SQLiteDB(KeyValueStore):
+    def __init__(self, path: str, cache_mb: int = 16, sync: bool = True):
+        """path: database file (created with parents if absent);
+        sync=False trades fsync-per-commit for speed (tests/benches)."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self.path = path
+        self._lock = threading.RLock()
+        self._closed = False
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        cur = self._conn.cursor()
+        cur.execute("PRAGMA journal_mode=WAL")
+        cur.execute(f"PRAGMA synchronous={'NORMAL' if sync else 'OFF'}")
+        cur.execute(f"PRAGMA cache_size={-1024 * cache_mb}")
+        cur.execute(
+            "CREATE TABLE IF NOT EXISTS kv ("
+            "k BLOB PRIMARY KEY, v BLOB NOT NULL) WITHOUT ROWID"
+        )
+        self._conn.commit()
+
+    # -- helpers -----------------------------------------------------------
+
+    def _check_open(self):
+        if self._closed:
+            raise RuntimeError("sqlitedb: database closed")
+
+    # -- KeyValueStore -----------------------------------------------------
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            self._check_open()
+            row = self._conn.execute(
+                "SELECT v FROM kv WHERE k = ?", (bytes(key),)
+            ).fetchone()
+        return bytes(row[0]) if row is not None else None
+
+    def put(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._check_open()
+            self._conn.execute(
+                "INSERT INTO kv(k, v) VALUES(?, ?) "
+                "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+                (bytes(key), bytes(value)),
+            )
+            self._conn.commit()
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            self._check_open()
+            self._conn.execute("DELETE FROM kv WHERE k = ?", (bytes(key),))
+            self._conn.commit()
+
+    def has(self, key: bytes) -> bool:
+        with self._lock:
+            self._check_open()
+            row = self._conn.execute(
+                "SELECT 1 FROM kv WHERE k = ?", (bytes(key),)
+            ).fetchone()
+        return row is not None
+
+    def write_batch(self, writes: List[Tuple[bytes, Optional[bytes]]]) -> None:
+        """One transaction: crash-atomic across the whole batch."""
+        with self._lock:
+            self._check_open()
+            cur = self._conn.cursor()
+            try:
+                cur.execute("BEGIN")
+                for k, v in writes:
+                    if v is None:
+                        cur.execute("DELETE FROM kv WHERE k = ?", (bytes(k),))
+                    else:
+                        cur.execute(
+                            "INSERT INTO kv(k, v) VALUES(?, ?) "
+                            "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+                            (bytes(k), bytes(v)),
+                        )
+                self._conn.commit()
+            except BaseException:
+                self._conn.rollback()
+                raise
+
+    def iterate(
+        self, prefix: bytes = b"", start: bytes = b""
+    ) -> Iterator[Tuple[bytes, bytes]]:
+        """Chunked scans re-anchored by last key: the iterator stays valid
+        across concurrent writes (same guarantee the reference relies on
+        for pruning + leaf serving)."""
+        lo = bytes(prefix) + bytes(start)
+        first = True
+        while True:
+            with self._lock:
+                self._check_open()  # close() mid-scan must fail loudly
+                if first:
+                    rows = self._conn.execute(
+                        "SELECT k, v FROM kv WHERE k >= ? ORDER BY k LIMIT ?",
+                        (lo, _ITER_CHUNK),
+                    ).fetchall()
+                else:
+                    rows = self._conn.execute(
+                        "SELECT k, v FROM kv WHERE k > ? ORDER BY k LIMIT ?",
+                        (lo, _ITER_CHUNK),
+                    ).fetchall()
+            for k, v in rows:
+                k = bytes(k)
+                if prefix and not k.startswith(prefix):
+                    return
+                yield k, bytes(v)
+            if len(rows) < _ITER_CHUNK:
+                return
+            lo = bytes(rows[-1][0])
+            first = False
+
+    def compact(self) -> None:
+        with self._lock:
+            self._check_open()
+            self._conn.execute("VACUUM")
+
+    def stat(self) -> dict:
+        with self._lock:
+            self._check_open()
+            n = self._conn.execute("SELECT COUNT(*) FROM kv").fetchone()[0]
+            pages = self._conn.execute("PRAGMA page_count").fetchone()[0]
+            page_size = self._conn.execute("PRAGMA page_size").fetchone()[0]
+        return {"entries": n, "bytes": pages * page_size}
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._conn.commit()
+            self._conn.close()
+
+    def __len__(self):
+        return self.stat()["entries"]

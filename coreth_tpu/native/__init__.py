@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 
 import numpy as np
@@ -25,18 +24,6 @@ _lib = None
 _load_failed = False
 
 
-def _build() -> bool:
-    cmd = [
-        "g++", "-O3", "-march=native", "-shared", "-fPIC",
-        "-o", _LIB, _SRC, "-lpthread",
-    ]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return True
-    except (subprocess.SubprocessError, FileNotFoundError):
-        return False
-
-
 def load():
     """Return the ctypes lib, building it if needed, or None on failure."""
     global _lib, _load_failed
@@ -45,13 +32,10 @@ def load():
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
-            if not _build():
-                _load_failed = True
-                return None
-        try:
-            lib = ctypes.CDLL(_LIB)
-        except OSError:
+        from ._build import build_and_load
+
+        lib = build_and_load(_SRC, _LIB, timeout=120)
+        if lib is None:
             _load_failed = True
             return None
         lib.keccak256.argtypes = [

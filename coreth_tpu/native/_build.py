@@ -1,0 +1,48 @@
+"""Shared native-library builder: compile C++ sources to a shared object
+crash/race-safely and CDLL it.
+
+Three loaders (keccak, mpt planner, secp256k1) share this path. The
+compile goes to a process-unique temp file followed by os.rename — POSIX
+rename is atomic, so concurrent processes (pytest parent + the recovery
+tests' child process, parallel test workers) can race freely: each either
+sees a complete .so or replaces it with its own complete build; a
+half-written file can never land at the final path."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+CXX_FLAGS = ["-O3", "-march=native", "-shared", "-fPIC"]
+
+
+def build_and_load(src: str, lib_path: str,
+                   timeout: int = 180) -> Optional[ctypes.CDLL]:
+    """Compile src -> lib_path (if stale) and dlopen it; None on failure."""
+    try:
+        stale = (not os.path.exists(lib_path)
+                 or os.path.getmtime(lib_path) < os.path.getmtime(src))
+    except OSError:
+        stale = True
+    if stale:
+        fd, tmp = tempfile.mkstemp(
+            suffix=".so", dir=os.path.dirname(lib_path) or "."
+        )
+        os.close(fd)
+        cmd = ["g++", *CXX_FLAGS, "-o", tmp, src, "-lpthread"]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=timeout)
+            os.rename(tmp, lib_path)
+        except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+    try:
+        return ctypes.CDLL(lib_path)
+    except OSError:
+        return None

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 from typing import List, Sequence, Tuple
 
@@ -42,17 +41,10 @@ def load():
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
-            cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-                   "-o", _LIB, _SRC, "-lpthread"]
-            try:
-                subprocess.run(cmd, check=True, capture_output=True, timeout=180)
-            except (subprocess.SubprocessError, FileNotFoundError):
-                _load_failed = True
-                return None
-        try:
-            lib = ctypes.CDLL(_LIB)
-        except OSError:
+        from ._build import build_and_load
+
+        lib = build_and_load(_SRC, _LIB)
+        if lib is None:
             _load_failed = True
             return None
         lib.mpt_plan.restype = ctypes.c_void_p
