@@ -1,18 +1,20 @@
-"""Headline benchmark: trie-root commitment nodes/sec, TPU-batched vs CPU.
+"""Headline benchmark: trie-root commitment nodes/sec, TPU vs CPU.
 
-Builds a random N-account state trie (the BASELINE.json config-#2 workload,
-scaled by CORETH_TPU_BENCH_LEAVES), then times root hashing of the full
-dirty set two ways:
+The workload is BASELINE.json config #2 scaled by CORETH_TPU_BENCH_LEAVES:
+an N-account state trie's full dirty-set commit. Both pipelines share the
+native planner (native/mpt.cpp — trie shape + node RLP + segment layout,
+the host work the reference does inside its hash walk,
+trie/trie.go:573-626 + trie/hasher.go:195-201) and are timed END TO END
+from the sorted leaf arrays to the 32-byte root:
 
-  cpu: the recursive host hasher over the C++ keccak — the reference's
-       trie/hasher.go path (its 16-goroutine fan-out maps to our
-       single-thread C++ walk; see BASELINE.md).
-  tpu: the level-synchronized BatchedHasher draining every level's node RLP
-       to the JAX keccak kernel on the default backend.
+  cpu: plan + threaded-C++ keccak over every level (the reference's
+       16-goroutine fan-out collapsed onto this host's cores)
+  tpu: plan + ONE bulk u32 transfer + per-segment device dispatches with
+       on-device digest patching (ops/keccak_planned.py)
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
-vs_baseline is the TPU/CPU throughput ratio (>1 is a win). Roots are
-asserted bit-identical before any number is reported.
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"};
+vs_baseline = tpu_rate / cpu_rate (>1 is a win). Roots are asserted
+bit-identical before any number is reported.
 """
 
 from __future__ import annotations
@@ -24,82 +26,64 @@ import sys
 import time
 
 
-def build_trie(n_leaves: int, seed: int = 1):
-    from coreth_tpu.trie.trie import Trie
+def build_workload(n_leaves: int, seed: int = 1):
+    """Sorted (keys, vals, offsets) numpy arrays — the shape StateDB
+    hands the committer (account hashes are already keccak outputs, so
+    random bytes model them exactly)."""
+    from coreth_tpu.native.mpt import items_to_arrays
 
     rng = random.Random(seed)
-    t = Trie()
-    for _ in range(n_leaves):
-        key = rng.randbytes(32)
-        val = rng.randbytes(rng.randint(40, 90))  # account-RLP-sized payloads
-        t.update(key, val)
-    return t
-
-
-def count_dirty(root) -> int:
-    from coreth_tpu.trie.node import FullNode, ShortNode
-
-    n = 0
-    stack = [root]
-    while stack:
-        x = stack.pop()
-        if isinstance(x, ShortNode):
-            n += 1
-            stack.append(x.val)
-        elif isinstance(x, FullNode):
-            n += 1
-            stack.extend(c for c in x.children[:16] if c is not None)
-    return n
-
-
-def time_hash(trie, mode: str, repeats: int):
-    """Best-of-N wall time hashing a fresh copy of the dirty trie.
-
-    mode: "cpu"   — recursive host hasher (reference trie/hasher.go analog)
-          "fused" — ONE device dispatch for the whole level-synchronized
-                    commit (ops/keccak_fused.py): digest patching between
-                    levels happens on-device, so tunnel latency is paid once
-    """
-    from coreth_tpu.trie.hasher import FusedHasher, Hasher
-
-    fused = FusedHasher() if mode == "fused" else None
-    best = float("inf")
-    root_hash = None
-    for _ in range(repeats):
-        t = trie.copy()
-        t0 = time.perf_counter()
-        if mode == "cpu":
-            h, _ = Hasher().hash(t.root, True)
-            rh = bytes(h)
-        else:
-            rh = bytes(fused.hash_root(t.root))
-        best = min(best, time.perf_counter() - t0)
-        if root_hash is None:
-            root_hash = rh
-        assert rh == root_hash
-    return best, root_hash
+    items = [
+        (rng.randbytes(32), rng.randbytes(rng.randint(40, 90)))
+        for _ in range(n_leaves)
+    ]
+    return items_to_arrays(items)
 
 
 def main():
     n_leaves = int(os.environ.get("CORETH_TPU_BENCH_LEAVES", "200000"))
     repeats = int(os.environ.get("CORETH_TPU_BENCH_REPEATS", "3"))
+    cpu_threads = int(os.environ.get("CORETH_TPU_BENCH_CPU_THREADS", "0")) or (
+        os.cpu_count() or 1
+    )
 
     from coreth_tpu.utils import enable_compilation_cache
 
     enable_compilation_cache()
+    from coreth_tpu.native.mpt import plan_commit
 
-    trie = build_trie(n_leaves)
-    nodes = count_dirty(trie.root)
+    keys, vals, off = build_workload(n_leaves)
 
-    # warm up the device path on the same workload so the fused program
-    # shape is compiled (and disk-cached) before the clock starts
-    time_hash(trie, "fused", 1)
+    # warm-up: compile/cache the device programs for this shape class
+    plan = plan_commit(keys, vals, off)
+    nodes = plan.num_nodes
+    root_dev = plan.execute_planned()
 
-    cpu_s, cpu_root = time_hash(trie, "cpu", repeats)
-    tpu_s, tpu_root = time_hash(trie, "fused", repeats)
-    if cpu_root != tpu_root:
+    def run_cpu():
+        p = plan_commit(keys, vals, off)
+        return p.execute_cpu(threads=cpu_threads)
+
+    def run_tpu():
+        p = plan_commit(keys, vals, off)
+        return p.execute_planned()
+
+    def best(fn):
+        b, root = float("inf"), None
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            r = fn()
+            b = min(b, time.perf_counter() - t0)
+            assert root is None or r == root
+            root = r
+        return b, root
+
+    cpu_s, root_cpu = best(run_cpu)
+    tpu_s, root_tpu = best(run_tpu)
+
+    if not (root_cpu == root_tpu == root_dev):
         print(
-            json.dumps({"error": "root mismatch", "cpu": cpu_root.hex(), "tpu": tpu_root.hex()}),
+            json.dumps({"error": "root mismatch",
+                        "cpu": root_cpu.hex(), "tpu": root_tpu.hex()}),
             file=sys.stderr,
         )
         sys.exit(1)

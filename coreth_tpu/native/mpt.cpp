@@ -520,7 +520,11 @@ void mpt_plan_execute_cpu(void* h, int threads, uint8_t* digests_out,
   for (auto& seg : p->segs) {
     int width = seg.blocks * kRate;
     int real = (int)seg.node_of_lane.size();
-    // patches reference earlier segments only — safe to apply before hashing
+    // patches reference earlier segments only — safe to apply before
+    // hashing. They are UNDONE after the segment hashes (see below) so
+    // the flat buffer keeps its zero digest slots: the device word path
+    // (export_words + scatter-add) shares this buffer zero-copy and
+    // requires pristine templates whatever order the caller runs in.
     for (size_t k = 0; k < seg.pl.size(); ++k) {
       if (seg.pl[k] >= real) continue;  // scratch-lane padding
       std::memcpy(p->flat.data() + seg.byte_base +
@@ -546,8 +550,62 @@ void mpt_plan_execute_cpu(void* h, int threads, uint8_t* digests_out,
     } else {
       hash_range(0, real);
     }
+    // restore the zero digest slots (templates stay pristine)
+    for (size_t k = 0; k < seg.pl.size(); ++k) {
+      if (seg.pl[k] >= real) continue;
+      std::memset(p->flat.data() + seg.byte_base +
+                      (int64_t)seg.pl[k] * width + seg.po[k],
+                  0, 32);
+    }
   }
   std::memcpy(out_root32, dig + (int64_t)p->root_pos * 32, 32);
+}
+
+// Zero-copy views for the u32 device path: the plan's flat buffer already
+// IS the padded little-endian word stream keccak absorbs; exposing the
+// pointer lets the host wrap it as an array and ship it straight to the
+// device with no intermediate copy (the plan object owns the memory).
+const uint8_t* mpt_plan_flat_ptr(void* h) { return ((Plan*)h)->flat.data(); }
+
+// specs only: int32[num_segments, 4] = (blocks, lanes, gstart, n_patches)
+void mpt_plan_specs(void* h, int32_t* specs) {
+  Plan* p = (Plan*)h;
+  for (size_t s = 0; s < p->segs.size(); ++s) {
+    specs[4 * s + 0] = p->segs[s].blocks;
+    specs[4 * s + 1] = p->segs[s].lanes;
+    specs[4 * s + 2] = p->segs[s].gstart;
+    specs[4 * s + 3] = p->segs[s].n_patches;
+  }
+}
+
+// Word-space patch export for the u32 device path (ops/keccak_planned.py):
+// per patch the 32-byte child digest lands at byte offset B in the flat
+// buffer; emitted as (dst_word = B/4, child_lane, shift = B%4). The device
+// scatter-adds 9-word contribution strips built from gathered digest words
+// — byte-level ops never reach the device. Pad entries (same per-segment
+// pow2 padding as mpt_plan_export) carry child_lane = -1, which the
+// executor maps to an all-zero sentinel digest row: their contribution is
+// 0 and the scatter-add is a no-op wherever it lands.
+void mpt_plan_export_word_patches(void* h, int32_t* dst_word,
+                                  int32_t* child_lane, int32_t* shift) {
+  Plan* p = (Plan*)h;
+  int64_t pp = 0;
+  for (auto& seg : p->segs) {
+    int width = seg.blocks * kRate;
+    int real = (int)seg.node_of_lane.size();
+    for (size_t k = 0; k < seg.pl.size(); ++k, ++pp) {
+      if (seg.pl[k] >= real) {  // scratch-lane pad entry
+        dst_word[pp] = 0;
+        child_lane[pp] = -1;
+        shift[pp] = 0;
+        continue;
+      }
+      int64_t byte_off = seg.byte_base + (int64_t)seg.pl[k] * width + seg.po[k];
+      dst_word[pp] = (int32_t)(byte_off >> 2);
+      child_lane[pp] = seg.pc[k];
+      shift[pp] = (int32_t)(byte_off & 3);
+    }
+  }
 }
 
 // Per-lane real message lengths (for exporting node RLP to the store).

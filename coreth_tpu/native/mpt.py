@@ -67,6 +67,14 @@ def load():
         ]
         lib.mpt_plan_msg_lens.restype = None
         lib.mpt_plan_msg_lens.argtypes = [ctypes.c_void_p, _i32p]
+        lib.mpt_plan_export_word_patches.restype = None
+        lib.mpt_plan_export_word_patches.argtypes = [
+            ctypes.c_void_p, _i32p, _i32p, _i32p,
+        ]
+        lib.mpt_plan_flat_ptr.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.mpt_plan_flat_ptr.argtypes = [ctypes.c_void_p]
+        lib.mpt_plan_specs.restype = None
+        lib.mpt_plan_specs.argtypes = [ctypes.c_void_p, _i32p]
         lib.mpt_plan_free.restype = None
         lib.mpt_plan_free.argtypes = [ctypes.c_void_p]
         _lib = lib
@@ -113,6 +121,49 @@ class CommitPlan:
         self._exported = (spec_t, flat, nblocks, pl, po, pc)
         return self._exported
 
+    def export_words(self):
+        """u32-device-path layout (ops/keccak_planned.py):
+        (specs tuple, flat_words u32[total_words], dst_word i32[P],
+        child_lane i32[P], shift i32[P]) — flat bytes reinterpreted as
+        little-endian words (keccak absorb order), patches in word space.
+
+        flat_words is a ZERO-COPY view into the plan's native buffer
+        (valid while this CommitPlan is alive); the only copies on the
+        way to the device are the h2d transfers themselves."""
+        if getattr(self, "_exported_words", None) is not None:
+            return self._exported_words
+        n_bytes = int(self._lib.mpt_plan_flat_bytes(self._h))
+        ptr = self._lib.mpt_plan_flat_ptr(self._h)
+        flat = np.ctypeslib.as_array(ptr, shape=(n_bytes,))
+        flat_words = flat.view(np.uint32)
+        from ..ops.keccak_fused import SegmentSpec
+
+        n_seg = int(self._lib.mpt_plan_num_segments(self._h))
+        specs_arr = np.empty((n_seg, 4), dtype=np.int32)
+        self._lib.mpt_plan_specs(self._h, specs_arr.reshape(-1))
+        specs = tuple(SegmentSpec(int(a), int(b), int(c), int(d))
+                      for a, b, c, d in specs_arr)
+        n_pat = int(self._lib.mpt_plan_total_patches(self._h))
+        dst_word = np.empty(n_pat, dtype=np.int32)
+        child_lane = np.empty(n_pat, dtype=np.int32)
+        shift = np.empty(n_pat, dtype=np.int32)
+        self._lib.mpt_plan_export_word_patches(
+            self._h, dst_word, child_lane, shift
+        )
+        self._exported_words = (specs, flat_words, dst_word, child_lane, shift)
+        return self._exported_words
+
+    def execute_planned(self, planned=None):
+        """u32 staged device execution (ops/keccak_planned.py); returns the
+        32-byte root."""
+        from ..ops.keccak_planned import PlannedCommit
+
+        runner = planned if planned is not None else _default_planned()
+        specs, flat_words, dst_word, child_lane, shift = self.export_words()
+        root, _ = runner.run(specs, flat_words, dst_word, child_lane, shift,
+                             self.root_pos)
+        return root
+
     def execute_cpu(self, threads: int = 1) -> bytes:
         """Host execution (threaded keccak); returns the 32-byte root."""
         root = np.empty(32, dtype=np.uint8)
@@ -140,6 +191,7 @@ class CommitPlan:
 
 
 _staged_singleton = None
+_planned_singleton = None
 
 
 def _default_staged():
@@ -149,6 +201,15 @@ def _default_staged():
 
         _staged_singleton = StagedCommit()
     return _staged_singleton
+
+
+def _default_planned():
+    global _planned_singleton
+    if _planned_singleton is None:
+        from ..ops.keccak_planned import PlannedCommit
+
+        _planned_singleton = PlannedCommit()
+    return _planned_singleton
 
 
 def plan_commit(keys: np.ndarray, vals_blob: bytes,
@@ -172,8 +233,9 @@ def plan_commit(keys: np.ndarray, vals_blob: bytes,
     return CommitPlan(h, lib)
 
 
-def plan_from_items(items: Sequence[Tuple[bytes, bytes]]) -> CommitPlan:
-    """Convenience: (key32, value) pairs, unsorted; duplicate keys resolve
+def items_to_arrays(items: Sequence[Tuple[bytes, bytes]]):
+    """(key32, value) pairs -> the planner's sorted array triple
+    (keys u8[n,32], vals_blob, offsets u64[n+1]); duplicate keys resolve
     last-write-wins (the natural trie-update semantics)."""
     dedup = {}
     for k, v in items:
@@ -186,4 +248,9 @@ def plan_from_items(items: Sequence[Tuple[bytes, bytes]]) -> CommitPlan:
     vals = b"".join(v for _, v in items)
     off = np.zeros(n + 1, dtype=np.uint64)
     np.cumsum(np.fromiter((len(v) for _, v in items), np.uint64, count=n), out=off[1:])
-    return plan_commit(keys, vals, off)
+    return keys, vals, off
+
+
+def plan_from_items(items: Sequence[Tuple[bytes, bytes]]) -> CommitPlan:
+    """Convenience: plan_commit over items_to_arrays(items)."""
+    return plan_commit(*items_to_arrays(items))

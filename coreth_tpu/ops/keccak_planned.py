@@ -1,0 +1,159 @@
+"""Planned trie commit, u32 end-to-end: one bulk transfer, device-resident
+chaining, zero byte-level ops on device.
+
+What profiling showed about the previous staged executor
+(ops/keccak_staged.py) on the tunneled TPU:
+  - per-segment device_put calls dominate: every small h2d pays the
+    tunnel round-trip (~75ms floor per synchronized step, 20 segments)
+  - uint8 reshaping/scatter inside the jitted steps costs ~100x the
+    keccak itself (TPU has no native u8 lanes; XLA relayouts)
+
+This executor removes both:
+  - the C++ planner's flat byte buffer IS the little-endian u32 word
+    stream keccak absorbs — numpy reinterprets it for free, ONE
+    device_put ships the whole commit (plus three patch tables + one
+    64-row metadata array)
+  - the parent<-child digest dependency resolves on device in word
+    space: for each patch, a 9-word contribution strip is built by
+    gathering the child's digest words and barrel-shifting them to the
+    byte offset (shift = offset%4); strips scatter-ADD into the flat
+    words. Template bytes at the destination are zero, and overlapping
+    strip boundaries touch disjoint bits, so add == or == exact patch.
+  - per-segment steps slice the device-resident flat words
+    (lax.dynamic_slice, offsets read from the uploaded metadata row, so
+    trie resizing never recompiles), hash with the scanned-block
+    segment kernel, and write digests into the donated dig buffer
+    (row 0 is an all-zero sentinel: pad patches point there)
+
+Reference seam: this replaces trie/hasher.go:124-139's 16-goroutine
+fan-out + channel joins for the whole-trie commit drain
+(core/state/statedb.go:952, trie/trie.go:585-626).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .keccak_jax import RATE
+from .keccak_staged import _segment_keccak
+
+WORDS_PER_BLOCK = RATE // 4  # 34
+MAX_SEGMENTS = 64
+
+
+def _strip_contributions(dig: jax.Array, child_row: jax.Array,
+                         shift: jax.Array) -> jax.Array:
+    """[P] child rows (+1-offset, 0 = zero sentinel) and byte shifts
+    -> uint32[P, 9] contribution strips."""
+    d = dig[child_row]                       # [P, 8]
+    p = d.shape[0]
+    dpad = jnp.concatenate(
+        [jnp.zeros((p, 1), jnp.uint32), d, jnp.zeros((p, 1), jnp.uint32)],
+        axis=1,
+    )                                        # [P, 10]; dpad[:, j] == D[j-1]
+    lsh = (8 * shift)[:, None]               # [P, 1]
+    rsh = (32 - 8 * shift)[:, None]
+    lo = dpad[:, :9] >> jnp.minimum(rsh, 31).astype(jnp.uint32)
+    lo = jnp.where(shift[:, None] == 0, jnp.uint32(0), lo)
+    hi = dpad[:, 1:] << lsh.astype(jnp.uint32)
+    return lo | hi
+
+
+def _make_step(seg_impl):
+    """Build the jitted per-segment step around one keccak kernel.
+
+    Static args are SHAPES only (lanes, blocks, npatch, all bucketed) —
+    the segment's offsets travel in the uploaded metadata row selected by
+    the traced scalar `seg_i`, so trie resizing never recompiles."""
+
+    @functools.partial(
+        jax.jit,
+        static_argnames=("lanes", "blocks", "npatch"),
+        donate_argnums=(0, 1),
+    )
+    def step(flat_words, dig, dstw_all, child_all, shift_all, meta, seg_i,
+             *, lanes: int, blocks: int, npatch: int):
+        """flat_words: uint32[W] (donated), dig: uint32[1+G, 8] (donated),
+        meta: int32[MAX_SEGMENTS, 3] = (word_off, gstart, patch_off)."""
+        row = jax.lax.dynamic_slice(meta, (seg_i, 0), (1, 3))[0]
+        word_off, gstart, patch_off = row[0], row[1], row[2]
+        if npatch:
+            dstw = jax.lax.dynamic_slice(dstw_all, (patch_off,), (npatch,))
+            child = jax.lax.dynamic_slice(child_all, (patch_off,), (npatch,))
+            shift = jax.lax.dynamic_slice(shift_all, (patch_off,), (npatch,))
+            strips = _strip_contributions(dig, child, shift)  # [P, 9]
+            idx = dstw[:, None] + jnp.arange(9, dtype=jnp.int32)[None, :]
+            flat_words = flat_words.at[idx.reshape(-1)].add(
+                strips.reshape(-1), mode="drop"
+            )
+        n_words = lanes * blocks * WORDS_PER_BLOCK
+        words = jax.lax.dynamic_slice(flat_words, (word_off,), (n_words,))
+        words = words.reshape(lanes, blocks, WORDS_PER_BLOCK)
+        out = seg_impl(words)                                 # [lanes, 8]
+        dig = jax.lax.dynamic_update_slice(
+            dig, out, (gstart + 1, jnp.int32(0))
+        )
+        return flat_words, dig
+
+    return step
+
+
+_default_step = _make_step(_segment_keccak)
+
+
+class PlannedCommit:
+    """Execute a CommitPlan's word-space export.
+
+    seg_impl: optional override of the per-segment keccak
+    (uint32[P, L, 34] -> uint32[P, 8]) — the Pallas kernel plugs in here
+    for lane counts its grid can tile."""
+
+    def __init__(self, seg_impl=None):
+        self._step = _default_step if seg_impl is None else _make_step(seg_impl)
+
+    def run(self, specs: Sequence, flat_words: np.ndarray,
+            dst_word: np.ndarray, child_lane: np.ndarray,
+            shift: np.ndarray, root_pos: int,
+            want_digests: bool = False) -> Tuple[bytes, Optional[np.ndarray]]:
+        """Inputs from CommitPlan.export_words(). Returns (root32,
+        dig uint32[G, 8] | None)."""
+        n_seg = len(specs)
+        if n_seg > MAX_SEGMENTS:
+            raise ValueError(f"{n_seg} segments > MAX_SEGMENTS={MAX_SEGMENTS}")
+        total_lanes = sum(s.lanes for s in specs)
+
+        meta = np.zeros((MAX_SEGMENTS, 3), np.int32)
+        word_off = 0
+        patch_off = 0
+        for i, s in enumerate(specs):
+            meta[i] = (word_off, s.gstart, patch_off)
+            word_off += s.lanes * s.blocks * WORDS_PER_BLOCK
+            patch_off += s.n_patches
+
+        # the whole commit's h2d: one bulk word stream + patch tables + meta
+        fw = jax.device_put(flat_words)
+        # +1: sentinel zero row that pad patches (child_lane == -1) gather
+        ch = jax.device_put((child_lane + 1).astype(np.int32))
+        dw = jax.device_put(dst_word)
+        sh = jax.device_put(shift)
+        mt = jax.device_put(meta)
+        # per-step segment ids sliced on device (no per-step h2d, and the
+        # step programs stay shape-keyed only)
+        seg_ids = jax.device_put(np.arange(MAX_SEGMENTS, dtype=np.int32))
+        dig = jnp.zeros((1 + total_lanes, 8), jnp.uint32)
+
+        for i, s in enumerate(specs):
+            fw, dig = self._step(
+                fw, dig, dw, ch, sh, mt, seg_ids[i],
+                lanes=s.lanes, blocks=s.blocks, npatch=s.n_patches,
+            )
+        if want_digests:
+            host = np.asarray(dig)
+            return host[root_pos + 1].astype("<u4").tobytes(), host[1:]
+        root = np.asarray(dig[root_pos + 1])
+        return root.astype("<u4").tobytes(), None

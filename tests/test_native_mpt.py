@@ -91,3 +91,81 @@ class TestNativePlanParity:
         off = np.array([0, 1, 2], dtype=np.uint64)
         with pytest.raises(ValueError):
             plan_commit(keys, b"ab", off)
+
+
+class TestPlannedU32Executor:
+    """The u32 planned executor (ops/keccak_planned.py) — strip-gather
+    patching, device-resident chaining — must be bit-exact vs the host
+    oracle on every shift/overlap/embedding shape."""
+
+    @pytest.mark.parametrize("n,vmin,vmax,seed", [
+        (50, 1, 10, 21),      # deep embedding, tiny values
+        (700, 40, 90, 22),    # account-shaped
+        (1500, 1, 220, 23),   # mixed, multi-block leaves
+    ])
+    def test_planned_root_matches_cpu(self, n, vmin, vmax, seed):
+        items = _random_items(n, vmin, vmax, seed)
+        plan = plan_from_items(items)
+        assert plan.execute_planned() == plan.execute_cpu()
+
+    def test_planned_digests_match_cpu_per_lane(self):
+        """Per-lane diff (SURVEY §7 hard-part 2: diff per node, not just
+        per root)."""
+        import numpy as np
+
+        from coreth_tpu.ops.keccak_planned import PlannedCommit
+
+        items = _random_items(900, 1, 150, 24)
+        plan = plan_from_items(items)
+        specs, flat_words, dst_word, child_lane, shift = plan.export_words()
+        root, dig = PlannedCommit().run(
+            specs, flat_words, dst_word, child_lane, shift, plan.root_pos,
+            want_digests=True,
+        )
+        cpu_dig = np.empty((plan.total_lanes, 32), np.uint8)
+        root_cpu = np.empty(32, np.uint8)
+        plan._lib.mpt_plan_execute_cpu(
+            plan._h, 1,
+            cpu_dig.ctypes.data_as(__import__("ctypes").c_void_p),
+            root_cpu,
+        )
+        got = dig.astype("<u4").view(np.uint8).reshape(plan.total_lanes, 32)
+        # only real lanes carry digests; scratch/pad lanes differ (host
+        # leaves them zero, device hashes the padded zero rows)
+        lens = np.empty(plan.total_lanes, np.int32)
+        plan._lib.mpt_plan_msg_lens(plan._h, lens)
+        real = lens > 0
+        assert (got[real] == cpu_dig[real]).all()
+        assert root == root_cpu.tobytes()
+
+    def test_word_patch_export_consistent_with_byte_patches(self):
+        import numpy as np
+
+        items = _random_items(400, 1, 100, 25)
+        plan = plan_from_items(items)
+        specs, flat, nblocks, pl, po, pc = plan.export()
+        _, _, dst_word, child_lane, shift = plan.export_words()
+        # walk segments to rebuild byte offsets from (lane, off)
+        byte_base = 0
+        k = 0
+        for s in specs:
+            width = s.blocks * 136
+            for _ in range(s.n_patches):
+                if child_lane[k] >= 0:
+                    off = byte_base + pl[k] * width + po[k]
+                    assert dst_word[k] == off // 4
+                    assert shift[k] == off % 4
+                    assert child_lane[k] == pc[k]
+                k += 1
+            byte_base += s.lanes * width
+        assert k == len(dst_word)
+
+    def test_cpu_then_planned_same_plan(self):
+        """execute_cpu must leave the shared flat buffer pristine (it
+        patches digests in place and restores them), so cross-checking
+        both paths on ONE plan is legal in either order."""
+        items = _random_items(600, 1, 120, 26)
+        plan = plan_from_items(items)
+        root_cpu = plan.execute_cpu()
+        assert plan.execute_planned() == root_cpu
+        assert plan.execute_cpu() == root_cpu  # and back again
