@@ -7,7 +7,7 @@ from __future__ import annotations
 import threading
 import time
 import uuid
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..core.types import bloom_lookup
 
@@ -48,6 +48,10 @@ class FilterSystem:
         self.b = backend
         self.lock = threading.Lock()
         self.filters: Dict[str, _Filter] = {}
+        # push subscribers: id -> (typ, crit, notify) — the WS
+        # eth_subscribe feeds (filter_system.go subscription channels)
+        self._subscribers: Dict[int, tuple] = {}
+        self._next_sub = 0
         # accepted-chain events drive filters (coreth semantics)
         backend.chain.subscribe_chain_accepted_event(self._on_accepted)
         if getattr(backend, "txpool", None) is not None:
@@ -69,12 +73,56 @@ class FilterSystem:
                     if hi is not None and block.number > hi:
                         continue
                     f.items.extend(self._filter_logs(logs, f.crit))
+            subscribers = list(self._subscribers.items())
+        # notify OUTSIDE the lock: subscriber callbacks write sockets. A
+        # dead client must never poison block acceptance — failures drop
+        # the subscriber.
+        for sid, (typ, crit, notify) in subscribers:
+            try:
+                if typ == "newHeads":
+                    notify(block)
+                elif typ == "logs":
+                    for l in self._filter_logs(logs, crit):
+                        notify(l)
+            except Exception:
+                with self.lock:
+                    self._subscribers.pop(sid, None)
 
     def _on_new_txs(self, txs) -> None:
         with self.lock:
             for f in self.filters.values():
                 if f.typ == "pendingTxs":
                     f.items.extend(t.hash() for t in txs)
+            subscribers = list(self._subscribers.items())
+        for sid, (typ, _crit, notify) in subscribers:
+            if typ != "newPendingTransactions":
+                continue
+            try:
+                for t in txs:
+                    notify(t.hash())
+            except Exception:
+                with self.lock:
+                    self._subscribers.pop(sid, None)
+
+    # --- push subscriptions (WS eth_subscribe) ----------------------------
+
+    def subscribe_push(self, typ: str, crit: Optional[dict],
+                       notify: Callable) -> Callable[[], None]:
+        """Register a push subscriber; returns its unsubscribe fn.
+        typ: newHeads | logs | newPendingTransactions."""
+        if typ not in ("newHeads", "logs", "newPendingTransactions"):
+            raise ValueError(f"unknown subscription kind {typ!r}")
+        parsed = self._parse_criteria(crit or {}) if typ == "logs" else {}
+        with self.lock:
+            sid = self._next_sub
+            self._next_sub += 1
+            self._subscribers[sid] = (typ, parsed, notify)
+
+        def cancel():
+            with self.lock:
+                self._subscribers.pop(sid, None)
+
+        return cancel
 
     # --- filter management ------------------------------------------------
 

@@ -34,6 +34,7 @@ class Subscription:
         self.id = sub_id
         self.notify = notify
         self.active = True
+        self.cleanup: Optional[Callable[[], None]] = None
 
 
 class RPCServer:
@@ -123,7 +124,19 @@ class RPCServer:
         sub = Subscription(sub_id, notify)
         with self.lock:
             self._subscriptions[sub_id] = sub
-        factory(lambda item: self._notify(sub_id, item), *params)
+        try:
+            cleanup = factory(lambda item: self._notify(sub_id, item), *params)
+        except BaseException:
+            with self.lock:
+                self._subscriptions.pop(sub_id, None)
+            raise
+        with self.lock:
+            if sub_id in self._subscriptions:
+                sub.cleanup = cleanup
+                return sub_id
+        # unsubscribe raced registration: tear the feed down now
+        if cleanup is not None:
+            cleanup()
         return sub_id
 
     def _notify(self, sub_id: str, item) -> None:
@@ -136,6 +149,8 @@ class RPCServer:
             sub = self._subscriptions.pop(sub_id, None)
         if sub is not None:
             sub.active = False
+            if sub.cleanup is not None:
+                sub.cleanup()
             return True
         return False
 

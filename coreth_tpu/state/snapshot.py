@@ -34,23 +34,55 @@ class SnapshotError(Exception):
     pass
 
 
-class DiskLayer:
-    """Persisted base layer (disklayer.go)."""
+def _merge_sources(sources):
+    """k-way merge of [(priority, iter[(key, value)])]: ascending by key,
+    LOWEST priority (youngest layer) wins ties; b"" values (deletions /
+    destructs) suppress the key entirely."""
+    import heapq
 
-    def __init__(self, diskdb, root: bytes, block_hash: bytes):
+    heads = []
+    for prio, it in sources:
+        for k, v in it:
+            heads.append((k, prio, v, it))
+            break
+    heapq.heapify(heads)
+    last_key = None
+    while heads:
+        k, prio, v, it = heapq.heappop(heads)
+        if k != last_key:
+            last_key = k
+            if v != b"":
+                yield k, v
+        for nk, nv in it:
+            heapq.heappush(heads, (nk, prio, nv, it))
+            break
+
+
+class DiskLayer:
+    """Persisted base layer (disklayer.go). `ready` is False while the
+    background generator is still populating it (generate.go) — reads
+    raise until generation completes, so callers fall back to the trie."""
+
+    def __init__(self, diskdb, root: bytes, block_hash: bytes,
+                 ready: bool = True):
         self.diskdb = diskdb
         self.root = root
         self.block_hash = block_hash
         self.stale = False
+        self.ready = ready
 
-    def account(self, addr_hash: bytes) -> Optional[bytes]:
+    def _check(self):
         if self.stale:
             raise SnapshotError("stale disk layer read")
+        if not self.ready:
+            raise SnapshotError("snapshot generation in progress")
+
+    def account(self, addr_hash: bytes) -> Optional[bytes]:
+        self._check()
         return self.diskdb.get(account_snapshot_key(addr_hash))
 
     def storage(self, addr_hash: bytes, slot_hash: bytes) -> Optional[bytes]:
-        if self.stale:
-            raise SnapshotError("stale disk layer read")
+        self._check()
         return self.diskdb.get(storage_snapshot_key(addr_hash, slot_hash))
 
     def parent(self):
@@ -104,27 +136,48 @@ class Tree:
 
     def __init__(self, diskdb, triedb, root: bytes,
                  block_hash: bytes = b"\x00" * 32, generate: bool = True,
-                 verify: bool = False):
+                 verify: bool = False, async_generate: bool = False):
         self.diskdb = diskdb
         self.triedb = triedb
         self.lock = threading.RLock()
         self.block_layers: Dict[bytes, object] = {}
         self.state_layers: Dict[bytes, Dict[bytes, object]] = {}
+        self._gen_thread: Optional[threading.Thread] = None
 
         stored_root = diskdb.get(SNAPSHOT_ROOT_KEY)
         stored_bh = diskdb.get(SNAPSHOT_BLOCK_HASH_KEY)
         if stored_root == root and stored_root is not None:
             base = DiskLayer(diskdb, root, stored_bh or block_hash)
         elif generate:
-            self._generate(root)
             # record the generating block hash too, or a later restart
             # would adopt a stale hash and break parent-layer lookups
             diskdb.put(SNAPSHOT_BLOCK_HASH_KEY, block_hash)
-            base = DiskLayer(diskdb, root, block_hash)
+            base = DiskLayer(diskdb, root, block_hash, ready=not async_generate)
+            if async_generate:
+                # generate.go: the disk layer builds in the background;
+                # reads fall back to the trie until it's ready
+                def _bg():
+                    try:
+                        self._generate(root)
+                        base.ready = True
+                    except Exception:
+                        pass  # layer stays not-ready; trie remains truth
+
+                self._gen_thread = threading.Thread(target=_bg, daemon=True)
+                self._gen_thread.start()
+            else:
+                self._generate(root)
         else:
             raise SnapshotError("snapshot missing and generation disabled")
         self._register(base)
         self.disk_layer = base
+
+    def wait_generation(self, timeout: Optional[float] = None) -> bool:
+        """Block until background generation finishes; True when ready."""
+        t = self._gen_thread
+        if t is not None:
+            t.join(timeout)
+        return self.disk_layer.ready
 
     # ------------------------------------------------------------ structure
 
@@ -178,6 +231,11 @@ class Tree:
     def flatten(self, block_hash: bytes) -> None:
         """Fold the accepted block's layer into the disk layer and drop all
         sibling branches (coreth snapshot.go Flatten)."""
+        # a background generator still writing the base layer must finish
+        # first: its final batch would otherwise resurrect pre-flatten
+        # values over the keys folded here (and re-point SNAPSHOT_ROOT_KEY
+        # at the stale root)
+        self.wait_generation()
         with self.lock:
             layer = self.block_layers.get(block_hash)
             if layer is None:
@@ -240,6 +298,71 @@ class Tree:
         prefix = SNAPSHOT_STORAGE_PREFIX + addr_hash
         for k, _ in self.diskdb.iterate(prefix=prefix):
             batch.delete(k)
+
+    # ------------------------------------------------------------- iterators
+
+    def _layer_stack(self, root: bytes):
+        """Layers from the youngest layer for [root] down to disk
+        (youngest first — nearer layers shadow deeper ones)."""
+        with self.lock:
+            layers = self.state_layers.get(root)
+            if not layers:
+                raise SnapshotError(f"no snapshot for root {root.hex()}")
+            layer = next(iter(layers.values()))
+        stack = []
+        while layer is not None:
+            stack.append(layer)
+            layer = layer.parent()
+        return stack
+
+    def account_iterator(self, root: bytes, start: bytes = b""):
+        """Merged ascending (addr_hash, slim_rlp) across the diff stack +
+        disk layer (iterator.go FastAccountIterator): the youngest layer
+        wins per key; destructed/deleted accounts are skipped."""
+        stack = self._layer_stack(root)
+
+        def sources():
+            for depth, layer in enumerate(stack):
+                if isinstance(layer, DiskLayer):
+                    layer._check()
+                    pfx = SNAPSHOT_ACCOUNT_PREFIX
+                    yield depth, (
+                        (k[len(pfx):], v)
+                        for k, v in layer.diskdb.iterate(prefix=pfx, start=start)
+                    )
+                else:
+                    entries = dict.fromkeys(layer.destructs, b"")
+                    entries.update(layer.accounts)
+                    yield depth, iter(sorted(
+                        (k, v) for k, v in entries.items() if k >= start
+                    ))
+
+        yield from _merge_sources(list(sources()))
+
+    def storage_iterator(self, root: bytes, addr_hash: bytes,
+                         start: bytes = b""):
+        """Merged ascending (slot_hash, value) for one account."""
+        stack = self._layer_stack(root)
+
+        def sources():
+            for depth, layer in enumerate(stack):
+                if isinstance(layer, DiskLayer):
+                    layer._check()
+                    pfx = SNAPSHOT_STORAGE_PREFIX + addr_hash
+                    yield depth, (
+                        (k[len(pfx):], v)
+                        for k, v in layer.diskdb.iterate(prefix=pfx, start=start)
+                    )
+                else:
+                    slots = layer.storage_data.get(addr_hash, {})
+                    yield depth, iter(sorted(
+                        (k, v) for k, v in slots.items() if k >= start
+                    ))
+                    # a destruct truncates everything below this layer
+                    if addr_hash in layer.destructs:
+                        return
+
+        yield from _merge_sources(list(sources()))
 
     # ------------------------------------------------------------ generation
 

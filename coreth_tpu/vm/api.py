@@ -306,4 +306,44 @@ def create_handlers(vm, allow_unfinalized_queries: bool = False) -> RPCServer:
     server.register_api("avax", AvaxAPI(vm))
     server.register_api("admin", AdminAPI(vm))
     server.register("health", "check", lambda: health_check(vm))
+
+    # eth_subscribe kinds (WS push; filter_system.go subscription feeds +
+    # vm.go:1178-1186 WS handler registration)
+    def new_heads_factory(notify):
+        return backend.filters.subscribe_push(
+            "newHeads", None, lambda blk: notify(eth._marshal_block(blk, False))
+        )
+
+    def logs_factory(notify, crit=None):
+        return backend.filters.subscribe_push(
+            "logs", crit or {}, lambda l: notify(eth._marshal_log(l, 0))
+        )
+
+    def pending_factory(notify):
+        return backend.filters.subscribe_push(
+            "newPendingTransactions", None, lambda h: notify(hb(h))
+        )
+
+    server.register_subscription("eth", "newHeads", new_heads_factory)
+    server.register_subscription("eth", "logs", logs_factory)
+    server.register_subscription("eth", "newPendingTransactions",
+                                 pending_factory)
     return server
+
+
+def serve_ws(vm, host: str = "127.0.0.1", port: int = 0,
+             rpc_server: Optional[RPCServer] = None):
+    """WS endpoint over the VM's RPC surface (vm.go:1178-1186: the /ws
+    handler with per-connection CPU limits from config). Returns
+    (WSServer, bound_port).
+
+    Pass the node's existing RPCServer (from create_handlers) to share
+    ONE backend/filter system between HTTP and WS — building a second
+    stack would double per-block filter work and split filter state."""
+    from ..rpc.websocket import WSServer
+
+    server = rpc_server if rpc_server is not None else create_handlers(vm)
+    cfg = vm.full_config
+    ws = WSServer(server, refill_rate=cfg.ws_cpu_refill_rate,
+                  max_stored=cfg.ws_cpu_max_stored)
+    return ws, ws.serve(host, port)

@@ -136,3 +136,103 @@ class TestTree:
         tree = Tree(diskdb, tdb, EMPTY_ROOT)
         with pytest.raises(SnapshotError):
             tree.update(b"\x01" * 32, b"\x77" * 32, set(), {}, {})
+
+
+class TestIterators:
+    def _tree_with_layers(self):
+        """disk layer {a1, a2, a3} + diff1 (update a2, add a4) + diff2
+        (destruct a1, delete a4-... )"""
+        diskdb = MemoryDB()
+        tdb = TrieDatabase(diskdb)
+        sdb = Database(tdb)
+        st = StateDB(EMPTY_ROOT, sdb)
+        addrs = [b"\x01" * 20, b"\x02" * 20, b"\x03" * 20]
+        for i, a in enumerate(addrs):
+            st.add_balance(a, 100 + i)
+        root = st.commit()
+        tdb.commit(root)
+        tree = Tree(diskdb, tdb, root)
+        from coreth_tpu.native import keccak256
+
+        hashes = sorted(keccak256(a) for a in addrs)
+        return tree, root, hashes
+
+    def test_account_iterator_disk_only(self):
+        tree, root, hashes = self._tree_with_layers()
+        got = [k for k, _ in tree.account_iterator(root)]
+        assert got == hashes
+        # start bound is inclusive and ascending
+        got2 = [k for k, _ in tree.account_iterator(root, start=hashes[1])]
+        assert got2 == hashes[1:]
+
+    def test_account_iterator_merges_diff_layers(self):
+        tree, root, hashes = self._tree_with_layers()
+        # diff1: overwrite hashes[0], add new account; diff2: destruct hashes[1]
+        new_hash = b"\x7f" * 32
+        r1, r2 = b"\x01" * 32, b"\x02" * 32
+        tree.update(r1, root, set(), {hashes[0]: b"young", new_hash: b"added"}, {})
+        tree.update(r2, r1, {hashes[1]}, {}, {})
+        items = dict(tree.account_iterator(r2))
+        assert items[hashes[0]] == b"young"        # youngest layer wins
+        assert hashes[1] not in items              # destructed
+        assert items[new_hash] == b"added"
+        assert hashes[2] in items                  # disk shows through
+        # iterating the PARENT root is unaffected by the child diff
+        items1 = dict(tree.account_iterator(r1))
+        assert hashes[1] in items1
+
+    def test_storage_iterator(self):
+        diskdb = MemoryDB()
+        tdb = TrieDatabase(diskdb)
+        sdb = Database(tdb)
+        st = StateDB(EMPTY_ROOT, sdb)
+        a = b"\x05" * 20
+        st.add_balance(a, 1)
+        # keys chosen to survive normalize_state_key (bit 0 of byte 0 cleared)
+        slots = {(b"\x02" + b"\x00" * 31): b"\x11", (b"\x04" + b"\x00" * 31): b"\x22"}
+        for k, v in slots.items():
+            st.set_state(a, k, v.rjust(32, b"\x00"))
+        root = st.commit()
+        tdb.commit(root)
+        tree = Tree(diskdb, tdb, root)
+        from coreth_tpu.native import keccak256
+
+        ah = keccak256(a)
+        got = list(tree.storage_iterator(root, ah))
+        assert len(got) == 2
+        want = sorted(keccak256(k) for k in slots)
+        assert [k for k, _ in got] == want
+
+    def test_unknown_root_raises(self):
+        tree, root, _ = self._tree_with_layers()
+        with pytest.raises(SnapshotError):
+            list(tree.account_iterator(b"\x99" * 32))
+
+
+class TestAsyncGeneration:
+    def test_background_generation(self):
+        diskdb = MemoryDB()
+        tdb = TrieDatabase(diskdb)
+        sdb = Database(tdb)
+        st = StateDB(EMPTY_ROOT, sdb)
+        for i in range(1, 200):
+            st.add_balance(i.to_bytes(20, "big"), i)
+        root = st.commit()
+        tdb.commit(root)
+        tree = Tree(diskdb, tdb, root, async_generate=True)
+        # generation may still be running; a not-ready read raises so
+        # callers fall back to the trie
+        assert tree.wait_generation(timeout=60)
+        from coreth_tpu.native import keccak256
+
+        assert tree.disk_layer.account(keccak256((5).to_bytes(20, "big")))
+        assert tree.verify_root(root)
+
+    def test_not_ready_reads_raise(self):
+        from coreth_tpu.state.snapshot import DiskLayer
+
+        layer = DiskLayer(MemoryDB(), b"\x00" * 32, b"\x00" * 32, ready=False)
+        with pytest.raises(SnapshotError):
+            layer.account(b"\x01" * 32)
+        layer.ready = True
+        assert layer.account(b"\x01" * 32) is None

@@ -18,6 +18,9 @@ from coreth_tpu.sync.client import ClientError, SyncClient
 from coreth_tpu.sync.handlers import SyncHandler
 from coreth_tpu.sync.messages import LeafsRequest, SyncSummary
 from coreth_tpu.sync.statesync import StateSyncer
+from coreth_tpu.state.database import Database
+from coreth_tpu.state.statedb import StateDB
+from coreth_tpu.trie.node import EMPTY_ROOT
 from coreth_tpu.trie.proof import prove
 from coreth_tpu.trie.proof_range import ProofError, verify_range_proof
 from coreth_tpu.trie.trie import Trie
@@ -409,3 +412,120 @@ class TestAtomicTrie:
         assert len(list(client_vm.atomic_trie.iterate())) == 1
         client_vm.shutdown()
         server.shutdown()
+
+
+class TestSnapshotLeafServing:
+    """Leafs served from the flat snapshot with trie fallback + deadline
+    budget (leafs_request.go:38,246; VERDICT round-1 item 8)."""
+
+    def _snapshot_setup(self):
+        from coreth_tpu.state.snapshot import Tree
+
+        diskdb = MemoryDB()
+        tdb = TrieDatabase(diskdb)
+        sdb = Database(tdb)
+        st = StateDB(EMPTY_ROOT, sdb)
+        addrs = [i.to_bytes(20, "big") for i in range(1, 60)]
+        for i, a in enumerate(addrs):
+            st.add_balance(a, 1000 + i)
+        root = st.commit()
+        tdb.commit(root)
+        tree = Tree(diskdb, tdb, root)
+        return diskdb, tdb, root, tree
+
+    def test_snapshot_serves_and_verifies(self):
+        from coreth_tpu.sync.handlers import LeafsRequestHandler
+        from coreth_tpu.sync.messages import LeafsRequest
+        from coreth_tpu.trie.proof_range import verify_range_proof
+        from coreth_tpu.native import keccak256
+
+        diskdb, tdb, root, tree = self._snapshot_setup()
+        plain = LeafsRequestHandler(tdb)
+        snap = LeafsRequestHandler(tdb, snaps=tree)
+
+        req = LeafsRequest(root=root, limit=16)
+        r_plain = plain.on_leafs_request(req)
+        # the fast path itself must serve (a fallback would also produce
+        # identical bytes, so assert on _try_snapshot directly)
+        trie = tdb.open_trie(root)
+        assert snap._try_snapshot(req, trie, 16, None) is not None
+        r_snap = snap.on_leafs_request(req)
+        assert r_snap.keys == r_plain.keys
+        assert r_snap.vals == r_plain.vals  # slim->full conversion matches
+        assert r_snap.more and r_plain.more
+        # client-side verification of the snapshot-served batch
+        proof_db = {keccak256(b): b for b in r_snap.proof_vals}
+        assert verify_range_proof(root, r_snap.keys[0], r_snap.keys[-1],
+                                  r_snap.keys, r_snap.vals, proof_db)
+
+    def test_stale_snapshot_falls_back_to_trie(self):
+        from coreth_tpu.state.snapshot import account_snapshot_key
+        from coreth_tpu.sync.handlers import LeafsRequestHandler
+        from coreth_tpu.sync.messages import LeafsRequest
+
+        diskdb, tdb, root, tree = self._snapshot_setup()
+        # corrupt one snapshot account: local verify must reject the flat
+        # read and the handler must serve the truth from the trie
+        k = next(iter(diskdb.iterate(prefix=b"a")))[0]
+        diskdb.put(k, b"\x01\x02\x03")
+        snap = LeafsRequestHandler(tdb, snaps=tree)
+        plain = LeafsRequestHandler(tdb)
+        req = LeafsRequest(root=root, limit=16)
+        assert snap.on_leafs_request(req).vals == plain.on_leafs_request(req).vals
+
+    def test_generating_snapshot_falls_back(self):
+        from coreth_tpu.sync.handlers import LeafsRequestHandler
+        from coreth_tpu.sync.messages import LeafsRequest
+
+        diskdb, tdb, root, tree = self._snapshot_setup()
+        tree.disk_layer.ready = False  # mid-generation
+        snap = LeafsRequestHandler(tdb, snaps=tree)
+        req = LeafsRequest(root=root, limit=8)
+        resp = snap.on_leafs_request(req)
+        assert len(resp.keys) == 8  # trie path served it
+
+    def test_deadline_budget_truncates(self):
+        import time
+
+        from coreth_tpu.sync.handlers import LeafsRequestHandler
+        from coreth_tpu.sync.messages import LeafsRequest
+
+        diskdb, tdb, root, tree = self._snapshot_setup()
+        snap = LeafsRequestHandler(tdb, snaps=tree)
+        req = LeafsRequest(root=root)
+        # a deadline already in the past: the snapshot loop yields nothing
+        # and marks more=True — the client just continues from `start`
+        resp = snap.on_leafs_request(req, deadline=time.monotonic() - 1)
+        assert resp.more
+
+    def test_storage_trie_request_served_from_snapshot(self):
+        from coreth_tpu.state.snapshot import Tree
+        from coreth_tpu.sync.handlers import LeafsRequestHandler
+        from coreth_tpu.sync.messages import LeafsRequest
+        from coreth_tpu.native import keccak256
+
+        diskdb = MemoryDB()
+        tdb = TrieDatabase(diskdb)
+        sdb = Database(tdb)
+        st = StateDB(EMPTY_ROOT, sdb)
+        a = b"\x09" * 20
+        st.add_balance(a, 5)
+        for i in range(2, 40, 2):
+            st.set_state(a, i.to_bytes(32, "big"), i.to_bytes(32, "big"))
+        root = st.commit()
+        tdb.commit(root)
+        tree = Tree(diskdb, tdb, root)
+        acct = st.get_or_new_state_object(a).data if hasattr(st, "get_or_new_state_object") else None
+        # resolve the storage root from the account trie
+        from coreth_tpu.state.statedb import _slim_to_account
+
+        slim = tree.disk_layer.account(keccak256(a))
+        storage_root = _slim_to_account(slim).root
+
+        snap = LeafsRequestHandler(tdb, snaps=tree)
+        plain = LeafsRequestHandler(tdb)
+        req = LeafsRequest(root=storage_root, account=keccak256(a), limit=10)
+        r_snap = snap.on_leafs_request(req)
+        r_plain = plain.on_leafs_request(req)
+        assert r_snap.keys == r_plain.keys and r_snap.vals == r_plain.vals
+        assert len(r_snap.keys) == 10 and r_snap.more
