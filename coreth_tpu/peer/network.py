@@ -88,8 +88,18 @@ class Network:
         self._transports: Dict[bytes, Callable[[bytes, bytes], bytes]] = {}
         self._gossip_handlers: List[Callable[[bytes, bytes], None]] = []
         self._request_handler: Optional[Callable[[bytes, bytes], bytes]] = None
+        self._failed_handlers: List[Callable[[bytes, bytes], None]] = []
+        self._cross_chain: Dict[bytes, Callable[[bytes], bytes]] = {}
         self._req_id = 0
         self.lock = threading.Lock()
+        self._pool = None  # lazy executor for deadlines + async requests
+
+    def _executor(self):
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(max_workers=16)
+        return self._pool
 
     # --- wiring -----------------------------------------------------------
 
@@ -109,6 +119,43 @@ class Network:
     def subscribe_gossip(self, handler: Callable[[bytes, bytes], None]) -> None:
         self._gossip_handlers.append(handler)
 
+    def subscribe_request_failed(self,
+                                 handler: Callable[[bytes, bytes], None]) -> None:
+        """AppRequestFailed observer (network.go:398): handler(node_id,
+        request) fires on transport fault OR deadline expiry."""
+        self._failed_handlers.append(handler)
+
+    def _fire_failed(self, node_id: bytes, request: bytes) -> None:
+        for h in self._failed_handlers:
+            try:
+                h(node_id, request)
+            except Exception:
+                pass
+
+    # --- cross-chain (network.go:199-328) ---------------------------------
+
+    def register_cross_chain_handler(self, chain_id: bytes,
+                                     handler: Callable[[bytes], bytes]) -> None:
+        """Serve inbound cross-chain requests addressed to [chain_id]."""
+        self._cross_chain[chain_id] = handler
+
+    def send_cross_chain_request(self, chain_id: bytes, request: bytes,
+                                 deadline: float = 10.0) -> bytes:
+        """SendCrossChainRequest: request another chain's VM (in-process
+        registry here; the reference routes via the node's chain router)."""
+        handler = self._cross_chain.get(chain_id)
+        if handler is None:
+            raise NetworkError(f"unknown chain {chain_id!r}")
+        fut = self._executor().submit(handler, request)
+        from concurrent.futures import TimeoutError as _FTimeout
+
+        try:
+            return fut.result(timeout=deadline)
+        except _FTimeout:
+            raise NetworkError("cross-chain request deadline exceeded")
+        except Exception as e:
+            raise NetworkError(f"cross-chain request failed: {e}") from e
+
     # --- outbound ---------------------------------------------------------
 
     def send_request_any(self, request: bytes, deadline: float = 10.0,
@@ -121,21 +168,54 @@ class Network:
 
     def send_request(self, node_id: bytes, request: bytes,
                      deadline: float = 10.0) -> bytes:
+        """Blocking request with a REAL deadline: the caller unblocks at
+        the deadline even if the peer never answers (the reference's
+        AppRequest deadline + AppRequestFailed, network.go:167-197,398)."""
         transport = self._transports.get(node_id)
         if transport is None:
             raise NetworkError(f"unknown peer {node_id!r}")
         start = time.monotonic()
+        fut = self._executor().submit(transport, self.self_id, request)
+        from concurrent.futures import TimeoutError as _FTimeout
+
         try:
-            response = transport(self.self_id, request)
+            response = fut.result(timeout=deadline)
+        except _FTimeout:
+            self.tracker.track_request(node_id, 0, deadline, False)
+            self._fire_failed(node_id, request)
+            raise NetworkError("request deadline exceeded")
         except Exception as e:
             self.tracker.track_request(node_id, 0, time.monotonic() - start, False)
+            self._fire_failed(node_id, request)
             raise NetworkError(f"request to {node_id!r} failed: {e}") from e
         elapsed = time.monotonic() - start
-        if elapsed > deadline:
-            self.tracker.track_request(node_id, 0, elapsed, False)
-            raise NetworkError("request deadline exceeded")
         self.tracker.track_request(node_id, len(response), elapsed, True)
         return response
+
+    def send_request_async(self, node_id: bytes, request: bytes,
+                           on_response: Callable[[bytes, bytes], None],
+                           on_failed: Optional[Callable[[bytes], None]] = None,
+                           deadline: float = 10.0):
+        """SendAppRequest's handler-registry shape (network.go:128-167):
+        returns immediately; on_response(node_id, response) or
+        on_failed(node_id) fires when the request resolves."""
+
+        def run():
+            try:
+                resp = self.send_request(node_id, request, deadline)
+            except NetworkError:
+                if on_failed is not None:
+                    try:
+                        on_failed(node_id)
+                    except Exception:
+                        pass
+                return
+            try:
+                on_response(node_id, resp)
+            except Exception:
+                pass
+
+        return self._executor().submit(run)
 
     def gossip(self, payload: bytes) -> None:
         for node_id, transport in list(self._transports.items()):

@@ -1,0 +1,74 @@
+"""Scripted fault injection for the peer layer (role of the reference's
+sync/client/mock_network.go:31-99 + mock_client.go intercept hooks).
+
+`FaultyTransport` wraps a working transport with a per-call script so
+tests (and chaos drills) can drive the retry/rotation/deadline machinery
+deterministically:
+
+    FaultyTransport(inner, script=["drop", "delay:0.2", "corrupt", "ok"])
+
+Script verbs:
+    ok            pass through
+    drop          raise (transport failure -> AppRequestFailed path)
+    delay:<s>     sleep s seconds, then pass through (deadline tests)
+    corrupt       pass through but flip bytes in the response (the
+                  client's proof validation must reject it)
+    empty         return b"" (undecodable response)
+
+The script consumes one verb per call; after the script is exhausted,
+every later call is "ok" (so a sync eventually completes — loop scripts
+by passing `cycle=True`)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List
+
+
+class TransportFault(Exception):
+    pass
+
+
+class FaultyTransport:
+    def __init__(self, inner: Callable[[bytes, bytes], bytes],
+                 script: List[str], cycle: bool = False):
+        self.inner = inner
+        self.script = list(script)
+        self.cycle = cycle
+        self.calls = 0
+        self.faults_injected = 0
+        self._lock = threading.Lock()
+
+    def _next_verb(self) -> str:
+        with self._lock:
+            i = self.calls
+            self.calls += 1
+            if not self.script:
+                return "ok"
+            if i < len(self.script):
+                return self.script[i]
+            if self.cycle:
+                return self.script[i % len(self.script)]
+            return "ok"
+
+    def __call__(self, sender: bytes, request: bytes) -> bytes:
+        verb = self._next_verb()
+        if verb == "ok":
+            return self.inner(sender, request)
+        self.faults_injected += 1
+        if verb == "drop":
+            raise TransportFault("scripted drop")
+        if verb.startswith("delay:"):
+            time.sleep(float(verb.split(":", 1)[1]))
+            return self.inner(sender, request)
+        if verb == "corrupt":
+            resp = self.inner(sender, request)
+            if not resp:
+                return resp
+            # flip bits mid-payload: keeps length, breaks proofs/digests
+            mid = len(resp) // 2
+            return resp[:mid] + bytes([resp[mid] ^ 0xFF]) + resp[mid + 1:]
+        if verb == "empty":
+            return b""
+        raise ValueError(f"unknown fault verb {verb!r}")

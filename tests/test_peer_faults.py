@@ -1,0 +1,147 @@
+"""Peer-layer hardening tests: deadlines, failure callbacks, and scripted
+fault injection driving the sync client's retry path (reference:
+peer/network.go:167-197,398 + sync/client/client.go:293-361 +
+mock_network.go scripted failures)."""
+
+import threading
+import time
+
+import pytest
+
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.peer.network import Network, NetworkError
+from coreth_tpu.peer.testing import FaultyTransport
+from coreth_tpu.state.database import Database
+from coreth_tpu.state.statedb import StateDB
+from coreth_tpu.sync.client import ClientError, SyncClient
+from coreth_tpu.sync.handlers import SyncHandler
+from coreth_tpu.trie.node import EMPTY_ROOT
+from coreth_tpu.trie.triedb import TrieDatabase
+
+
+def make_state(n_accounts=50):
+    diskdb = MemoryDB()
+    tdb = TrieDatabase(diskdb)
+    st = StateDB(EMPTY_ROOT, Database(tdb))
+    for i in range(1, n_accounts + 1):
+        st.add_balance(i.to_bytes(20, "big"), 1000 + i)
+    root = st.commit()
+    tdb.commit(root)
+    return diskdb, tdb, root
+
+
+class _FakeChain:
+    def get_block(self, h):
+        return None
+
+
+def make_handler(tdb, diskdb):
+    return SyncHandler(_FakeChain(), tdb, diskdb)
+
+
+class TestDeadlines:
+    def test_slow_peer_times_out_at_deadline(self):
+        net = Network()
+        hang = threading.Event()
+
+        def slow(sender, req):
+            hang.wait(30)
+            return b"late"
+
+        net.connect(b"slow", slow)
+        t0 = time.monotonic()
+        with pytest.raises(NetworkError, match="deadline"):
+            net.send_request(b"slow", b"ping", deadline=0.3)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5  # unblocked at the deadline, not at 30s
+        hang.set()
+
+    def test_failure_callback_fires(self):
+        net = Network()
+        failures = []
+        net.subscribe_request_failed(lambda nid, req: failures.append((nid, req)))
+
+        net.connect(b"dead", FaultyTransport(lambda s, r: b"", ["drop"]))
+        with pytest.raises(NetworkError):
+            net.send_request(b"dead", b"hello")
+        assert failures == [(b"dead", b"hello")]
+
+    def test_async_request_callbacks(self):
+        net = Network()
+        net.connect(b"ok", lambda s, r: b"pong:" + r)
+        net.connect(b"bad", FaultyTransport(lambda s, r: b"", ["drop"]))
+        got, failed = [], []
+        f1 = net.send_request_async(b"ok", b"x", lambda n, r: got.append((n, r)))
+        f2 = net.send_request_async(b"bad", b"y", lambda n, r: got.append((n, r)),
+                                    on_failed=lambda n: failed.append(n))
+        f1.result(); f2.result()
+        assert got == [(b"ok", b"pong:x")]
+        assert failed == [b"bad"]
+
+    def test_cross_chain_request(self):
+        net = Network()
+        net.register_cross_chain_handler(b"X", lambda req: b"from-X:" + req)
+        assert net.send_cross_chain_request(b"X", b"q") == b"from-X:q"
+        with pytest.raises(NetworkError):
+            net.send_cross_chain_request(b"Y", b"q")
+
+
+class TestFaultInjectionSync:
+    def _wire(self, scripts):
+        """N peers all serving the same state, each behind its own fault
+        script; returns (client, root, transports)."""
+        diskdb, tdb, root = make_state()
+        handler = make_handler(tdb, diskdb)
+        net = Network(self_id=b"client")
+        transports = {}
+        for name, script in scripts.items():
+            ft = FaultyTransport(
+                lambda s, r, h=handler: h.handle(s, r), script
+            )
+            transports[name] = ft
+            net.connect(name, ft)
+        return SyncClient(net), root, transports
+
+    def test_leafs_retry_past_drops_and_corruption(self):
+        client, root, transports = self._wire({
+            b"p1": ["drop", "drop"],
+            b"p2": ["corrupt", "empty"],
+            b"p3": ["ok"],
+        })
+        resp = client.get_leafs(root, limit=10)
+        assert len(resp.keys) == 10
+        total_faults = sum(t.faults_injected for t in transports.values())
+        assert total_faults >= 1  # at least one bad peer was tried + rotated
+
+    def test_all_faulty_exhausts_retries(self):
+        client, root, transports = self._wire({
+            b"p1": ["drop"] * 40,
+            b"p2": ["corrupt"] * 40,
+        })
+        client.max_attempts = 6
+        with pytest.raises(ClientError, match="exhausted"):
+            client.get_leafs(root, limit=5)
+
+    def test_full_state_sync_under_faults(self):
+        """The statesync drain completes even when every peer misbehaves
+        intermittently (drop/corrupt/delay cycling)."""
+        from coreth_tpu.sync.statesync import StateSyncer
+
+        diskdb, tdb, root = make_state(80)
+        handler = make_handler(tdb, diskdb)
+        net = Network(self_id=b"client")
+        net.connect(b"flaky1", FaultyTransport(
+            lambda s, r: handler.handle(s, r),
+            ["drop", "ok", "corrupt", "ok"], cycle=True))
+        net.connect(b"flaky2", FaultyTransport(
+            lambda s, r: handler.handle(s, r),
+            ["corrupt", "ok", "drop", "ok"], cycle=True))
+        client = SyncClient(net)
+
+        dst_db = MemoryDB()
+        syncer = StateSyncer(client, dst_db, root)
+        syncer.sync()
+        # the synced trie must reproduce the root bit-exactly
+        dst_tdb = TrieDatabase(dst_db)
+        st = StateDB(root, Database(dst_tdb))
+        assert st.get_balance((5).to_bytes(20, "big")) == 1005
