@@ -41,6 +41,8 @@ class CacheConfig:
     # device keccak (trie/trie.go:618-619 parallel-threshold analog); "off":
     # recursive CPU hasher everywhere.
     device_hasher: str = "auto"
+    # bloom-bit index section (bloom_indexer.go BloomBitsBlocks)
+    bloom_section_size: int = 4096
 
 
 class BlockValidator:
@@ -171,6 +173,26 @@ class BlockChain:
                 self.last_accepted.root,
                 block_hash=self.last_accepted.hash(),
             )
+
+        # sectioned bloom-bit index for historical log search
+        # (core/bloom_indexer.go; section commits ride the acceptor queue)
+        from .bloom_index import BloomIndexer
+
+        self.bloom_indexer = BloomIndexer(
+            diskdb, section_size=cache_config.bloom_section_size
+        )
+        # backfill the in-flight section (genesis + anything accepted
+        # before this boot never rode the acceptor queue)
+        tip_n = self.last_accepted.number
+        sec_start = tip_n - tip_n % cache_config.bloom_section_size
+        for n in range(sec_start, tip_n + 1):
+            # headers only: the backfill needs nothing but the 256-byte
+            # bloom, not whole decoded blocks
+            h = rawdb.read_canonical_hash(diskdb, n)
+            blob = rawdb.read_header_rlp(diskdb, n, h) if h else None
+            if blob is None:
+                break
+            self.bloom_indexer.add_block(n, Header.decode(blob).bloom)
 
         # async acceptor queue (blockchain.go:563-611): decouples consensus
         # Accept from expensive post-accept work, with backpressure
@@ -481,6 +503,7 @@ class BlockChain:
                 self.snaps.flatten(block.hash())
             self.trie_writer.accept_trie(block)
         _metrics.gauge("chain/head/accepted").update(block.number)
+        self.bloom_indexer.add_block(block.number, block.header.bloom)
         for i, tx in enumerate(block.transactions):
             rawdb.write_tx_lookup(self.diskdb, tx.hash(), block.number)
         receipts = self.get_receipts(block.hash()) or []

@@ -154,3 +154,49 @@ def read_tx_lookup(db, tx_hash: bytes) -> Optional[int]:
 
 def write_tx_lookup(db, tx_hash: bytes, number: int) -> None:
     db.put(TX_LOOKUP_PREFIX + tx_hash, _num(number))
+
+
+def inspect_database(db) -> dict:
+    """InspectDatabase (core/rawdb/database.go): one full-keyspace walk
+    categorizing entry counts and sizes by schema prefix — the operator's
+    'where did my disk go' view."""
+    categories = [
+        ("headers", HEADER_PREFIX, 41),          # h + num(8) + hash(32)
+        ("canonicalHashes", HEADER_PREFIX, 10),  # h + num(8) + 'n'
+        ("headerNumbers", HEADER_NUMBER_PREFIX, 33),
+        ("bodies", BODY_PREFIX, 41),
+        ("receipts", RECEIPTS_PREFIX, 41),
+        ("code", CODE_PREFIX, 33),
+        ("txLookups", TX_LOOKUP_PREFIX, 33),
+        ("accountSnapshot", SNAPSHOT_ACCOUNT_PREFIX, 33),
+        ("storageSnapshot", SNAPSHOT_STORAGE_PREFIX, 65),
+        ("bloomBits", b"B", 7),
+        ("syncProgress", b"sync_", 0),
+    ]
+    stats = {name: {"count": 0, "bytes": 0} for name, _, _ in categories}
+    stats["trieNodes"] = {"count": 0, "bytes": 0}
+    stats["metadata"] = {"count": 0, "bytes": 0}
+    stats["other"] = {"count": 0, "bytes": 0}
+    meta_keys = {
+        SNAPSHOT_ROOT_KEY, SNAPSHOT_BLOCK_HASH_KEY, SNAPSHOT_GENERATOR_KEY,
+        HEAD_HEADER_KEY, HEAD_BLOCK_KEY, ACCEPTOR_TIP_KEY, SYNC_ROOT_KEY,
+    }
+    total = {"count": 0, "bytes": 0}
+    for k, v in db.iterate():
+        size = len(k) + len(v)
+        total["count"] += 1
+        total["bytes"] += size
+        if k in meta_keys:
+            bucket = "metadata"
+        else:
+            for name, prefix, klen in categories:
+                if k.startswith(prefix) and (klen == 0 or len(k) == klen):
+                    bucket = name
+                    break
+            else:
+                # 32-byte keys are hash-addressed trie nodes (hashdb scheme)
+                bucket = "trieNodes" if len(k) == 32 else "other"
+        stats[bucket]["count"] += 1
+        stats[bucket]["bytes"] += size
+    stats["total"] = total
+    return stats

@@ -50,6 +50,89 @@ class TxPoolConfig:
     global_slots: int = 4096
     account_queue: int = 64
     global_queue: int = 1024
+    journal: str = ""             # local-tx journal path ("" disables)
+    locals: Tuple[bytes, ...] = ()  # addresses always treated as local
+
+
+class _PricedList:
+    """Min-heap over remote txs by fee cap (txpool.go pricedList): pop
+    the cheapest victim when the pool overflows. Entries go stale when
+    their tx leaves the pool; stale heads are skipped lazily."""
+
+    def __init__(self):
+        import heapq as _hq
+
+        self._hq = _hq
+        self._heap: list = []   # (gas_fee_cap, seq, hash)
+        self._seq = 0
+
+    def push(self, tx: Transaction) -> None:
+        self._hq.heappush(self._heap, (tx.gas_fee_cap, self._seq, tx.hash()))
+        self._seq += 1
+
+    def cheapest(self, alive) -> Optional[Transaction]:
+        """Peek the cheapest live remote tx (alive: hash -> tx | None)."""
+        while self._heap:
+            _, _, h = self._heap[0]
+            tx = alive(h)
+            if tx is None:
+                self._hq.heappop(self._heap)
+                continue
+            return tx
+        return None
+
+
+class TxJournal:
+    """Disk journal of local transactions (txpool journal.go): appended
+    on admission, replayed on boot, rewritten compact on rotate()."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def load(self, add_fn) -> int:
+        import os
+
+        from .. import rlp
+
+        if not self.path or not os.path.exists(self.path):
+            return 0
+        loaded = 0
+        with open(self.path, "rb") as f:
+            blob = f.read()
+        pos = 0
+        while pos < len(blob):
+            try:
+                item, pos = rlp._decode_at(blob, pos)
+                tx = Transaction.decode(bytes(item))
+            except Exception:
+                break  # truncated tail (crash mid-append): keep the rest
+            try:
+                add_fn(tx)
+                loaded += 1
+            except Exception:
+                pass  # stale journal entries (already mined) are fine
+        return loaded
+
+    def insert(self, tx: Transaction) -> None:
+        if not self.path:
+            return
+        from .. import rlp
+
+        with open(self.path, "ab") as f:
+            f.write(rlp.encode(tx.encode()))
+
+    def rotate(self, all_local: List[Transaction]) -> None:
+        if not self.path:
+            return
+        import os
+
+        from .. import rlp
+
+        tmp = self.path + ".new"
+        with open(tmp, "wb") as f:
+            for tx in all_local:
+                f.write(rlp.encode(tx.encode()))
+        os.replace(tmp, self.path)
 
 
 class _TxList:
@@ -138,7 +221,96 @@ class TxPool:
         # new-tx event subscribers (gossip wiring)
         self._tx_feed: list = []
 
+        # locals + journal (txpool.go accountSet + journal.go): local
+        # senders bypass caps, never get price-evicted, and their txs
+        # survive restarts through the journal
+        self.locals: set = set(config.locals)
+        self.priced_pending = _PricedList()
+        self.priced_queued = _PricedList()
+        self.journal = TxJournal(config.journal) if config.journal else None
+        if self.journal is not None:
+            self.journal.load(lambda tx: self.add(tx, local=True, journal=False))
+            self._rotate_journal()
+
         chain.subscribe_chain_event(lambda blk, logs: self.reset(blk.header))
+
+    # ------------------------------------------------------------ locals
+
+    def _is_local(self, sender: bytes) -> bool:
+        return sender in self.locals
+
+    def _local_txs(self) -> List[Transaction]:
+        out = []
+        for part in (self.pending, self.queue):
+            for sender, lst in part.items():
+                if sender in self.locals:
+                    out.extend(lst.items[n] for n in sorted(lst.items))
+        return out
+
+    def _rotate_journal(self) -> None:
+        if self.journal is not None:
+            self.journal.rotate(self._local_txs())
+
+    # ------------------------------------------------------------ eviction
+
+    def _evict_for(self, tx: Transaction, partition: Dict[bytes, "_TxList"],
+                   heap: "_PricedList") -> bool:
+        """Partition overflow: drop that partition's cheapest REMOTE tx if
+        [tx] outbids it (txpool.go pricedList.Discard). Each partition has
+        its own heap (txs re-push when they move partitions), so occupancy
+        can never exceed its cap. False = tx itself is the loser."""
+
+        def alive_in_partition(h):
+            t = self.all.get(h)
+            if t is None:
+                return None
+            sender = self.signer.sender(t)
+            if self._is_local(sender):
+                return None
+            lst = partition.get(sender)
+            if lst is None or lst.get(t.nonce) is not t:
+                return None
+            return t
+
+        victim = heap.cheapest(alive_in_partition)
+        if victim is None or victim.gas_fee_cap >= tx.gas_fee_cap:
+            return False
+        self._remove(victim.hash())
+        return True
+
+    def _remove(self, tx_hash: bytes) -> None:
+        """Drop one tx from whichever partition holds it; demote later
+        pending nonces of the same sender back to the queue."""
+        tx = self.all.pop(tx_hash, None)
+        if tx is None:
+            return
+        sender = self.signer.sender(tx)
+        plist = self.pending.get(sender)
+        if plist is not None and plist.get(tx.nonce) is tx:
+            del plist.items[tx.nonce]
+            self._pending_count -= 1
+            # nonce gap: everything after it is no longer executable
+            laters = [plist.items[n] for n in sorted(plist.items)
+                      if n > tx.nonce]
+            for later in laters:
+                del plist.items[later.nonce]
+                self._pending_count -= 1
+                qlist = self.queue.setdefault(sender, _TxList())
+                if qlist.get(later.nonce) is None:
+                    self._queued_count += 1
+                qlist.items[later.nonce] = later
+                if not self._is_local(sender):
+                    self.priced_queued.push(later)
+            self.pending_nonces[sender] = tx.nonce
+            if plist.empty():
+                self.pending.pop(sender, None)
+            return
+        qlist = self.queue.get(sender)
+        if qlist is not None and qlist.get(tx.nonce) is tx:
+            del qlist.items[tx.nonce]
+            self._queued_count -= 1
+            if qlist.empty():
+                self.queue.pop(sender, None)
 
     # ------------------------------------------------------------ admission
 
@@ -181,12 +353,16 @@ class TxPool:
     def add_local(self, tx: Transaction) -> None:
         self.add(tx, local=True)
 
-    def add(self, tx: Transaction, local: bool = False) -> None:
+    def add(self, tx: Transaction, local: bool = False,
+            journal: bool = True) -> None:
         with self.mu:
             h = tx.hash()
             if h in self.all:
                 raise TxPoolError(ErrAlreadyKnown)
             sender = self._validate(tx, local)
+            local = local or self._is_local(sender)
+            if local:
+                self.locals.add(sender)
 
             # executable now?
             state_nonce = self.statedb.get_nonce(sender)
@@ -194,12 +370,15 @@ class TxPool:
 
             # global capacity checks (txpool.go DefaultConfig slots): a
             # replacement never grows the pool, so only new slots count;
-            # local txs bypass the caps in both partitions
+            # local txs bypass the caps; a remote overflow evicts the
+            # cheapest remote when the newcomer outbids it (pricedList)
             if tx.nonce <= pending_nonce:
                 plist = self.pending.setdefault(sender, _TxList())
                 is_replacement = plist.get(tx.nonce) is not None
                 if (not is_replacement and not local
-                        and self._pending_count >= self.config.global_slots):
+                        and self._pending_count >= self.config.global_slots
+                        and not self._evict_for(tx, self.pending,
+                                                self.priced_pending)):
                     raise TxPoolError(ErrUnderpriced + ": pool full")
                 inserted, old = plist.add(tx, self.config.price_bump)
                 if not inserted:
@@ -217,7 +396,9 @@ class TxPool:
                     raise TxPoolError(ErrAccountLimitExceeded)
                 is_replacement = qlist.get(tx.nonce) is not None
                 if (not is_replacement and not local
-                        and self._queued_count >= self.config.global_queue):
+                        and self._queued_count >= self.config.global_queue
+                        and not self._evict_for(tx, self.queue,
+                                                self.priced_queued)):
                     raise TxPoolError(ErrAccountLimitExceeded + ": queue full")
                 inserted, old = qlist.add(tx, self.config.price_bump)
                 if not inserted:
@@ -227,6 +408,12 @@ class TxPool:
                 if old is not None:
                     self.all.pop(old.hash(), None)
                 self.all[h] = tx
+            if not local:
+                heap = (self.priced_pending
+                        if tx.nonce <= pending_nonce else self.priced_queued)
+                heap.push(tx)
+            elif journal and self.journal is not None:
+                self.journal.insert(tx)
             for fn in self._tx_feed:
                 fn([tx])
 
@@ -242,6 +429,8 @@ class TxPool:
             plist = self.pending.setdefault(sender, _TxList())
             was_new = plist.get(tx.nonce) is None
             plist.add(tx, self.config.price_bump)
+            if not self._is_local(sender):
+                self.priced_pending.push(tx)
             del qlist.items[tx.nonce]
             self._queued_count -= 1
             if was_new:
@@ -327,3 +516,6 @@ class TxPool:
             self._queued_count = sum(len(l) for l in self.queue.values())
             for addr in list(self.queue):
                 self._promote(addr)
+            # compact the local-tx journal to the survivors (the reference
+            # rotates on its reset loop; append-only would grow unbounded)
+            self._rotate_journal()

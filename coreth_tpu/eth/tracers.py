@@ -98,6 +98,106 @@ class CallTracer:
         return self.frames[0] if self.frames else {}
 
 
+class FourByteTracer:
+    """Native 4byte tracer (eth/tracers/native/4byte.go): counts
+    selector/calldata-size pairs across all call frames."""
+
+    def __init__(self):
+        self.ids: dict = {}
+
+    def enter(self, typ: str, from_: bytes, to, value: int, gas: int,
+              input_: bytes) -> None:
+        if typ in ("CREATE", "CREATE2") or len(input_) < 4:
+            return
+        key = f"0x{input_[:4].hex()}-{len(input_) - 4}"
+        self.ids[key] = self.ids.get(key, 0) + 1
+
+    def exit(self, output: bytes, gas_used: int, err) -> None:
+        pass
+
+    def capture_state(self, *a, **kw) -> None:
+        pass
+
+    def result(self) -> dict:
+        return dict(self.ids)
+
+
+class PrestateTracer:
+    """Native prestate tracer (eth/tracers/native/prestate.go): the value
+    of every account/slot BEFORE the traced transaction, captured on
+    first touch through a recording StateDB proxy."""
+
+    def __init__(self):
+        self.accounts: dict = {}
+
+    def wrap(self, statedb):
+        return _PrestateProxy(statedb, self)
+
+    def _touch_account(self, statedb, addr: bytes) -> dict:
+        if addr not in self.accounts:
+            self.accounts[addr] = {
+                "balance": statedb.get_balance(addr),
+                "nonce": statedb.get_nonce(addr),
+                "code": statedb.get_code(addr),
+                "storage": {},
+            }
+        return self.accounts[addr]
+
+    def _touch_slot(self, statedb, addr: bytes, key: bytes) -> None:
+        acct = self._touch_account(statedb, addr)
+        if key not in acct["storage"]:
+            acct["storage"][key] = statedb.get_state(addr, key)
+
+    def result(self) -> dict:
+        out = {}
+        for addr, a in self.accounts.items():
+            entry = {"balance": hx(a["balance"]), "nonce": a["nonce"]}
+            if a["code"]:
+                entry["code"] = hb(a["code"])
+            if a["storage"]:
+                entry["storage"] = {
+                    hb(k): hb(v.rjust(32, b"\x00") if v else b"\x00" * 32)
+                    for k, v in a["storage"].items()
+                }
+            out[hb(addr)] = entry
+        return out
+
+
+class _PrestateProxy:
+    """Delegating StateDB wrapper recording first-touch values. Mutators
+    record BEFORE delegating so the captured value is pre-transaction."""
+
+    _RECORD_ACCOUNT = {
+        "get_balance", "add_balance", "sub_balance", "get_nonce",
+        "set_nonce", "get_code", "set_code", "get_code_hash",
+        "get_code_size", "create_account", "exist", "empty", "suicide",
+    }
+    _RECORD_SLOT = {"get_state", "set_state", "get_committed_state"}
+
+    def __init__(self, inner, tracer: PrestateTracer):
+        object.__setattr__(self, "_inner", inner)
+        object.__setattr__(self, "_tracer", tracer)
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name in self._RECORD_ACCOUNT:
+            def wrapped(addr, *a, **kw):
+                self._tracer._touch_account(self._inner, addr)
+                return attr(addr, *a, **kw)
+
+            return wrapped
+        if name in self._RECORD_SLOT:
+            def wrapped(addr, key, *a, **kw):
+                self._tracer._touch_slot(self._inner, addr, key)
+                return attr(addr, key, *a, **kw)
+
+            return wrapped
+        return attr
+
+    def __setattr__(self, name, value):
+        setattr(self._inner, name, value)
+
+
 class DebugAPI:
     """debug namespace: traceTransaction/traceBlockByNumber/traceCall."""
 
@@ -119,13 +219,17 @@ class DebugAPI:
             tracer = tracer_factory() if traced else None
             cfg = Config(tracer=tracer if isinstance(tracer, StructLogger) else None)
             block_ctx = new_block_context(blk.header, chain)
-            evm = EVM(block_ctx, TxContext(), state, self.b.chain_config, cfg)
-            if isinstance(tracer, CallTracer):
+            tx_state = state
+            if isinstance(tracer, PrestateTracer):
+                tx_state = tracer.wrap(state)
+            evm = EVM(block_ctx, TxContext(), tx_state, self.b.chain_config, cfg)
+            if isinstance(tracer, (CallTracer, FourByteTracer)):
                 evm = _instrument_call_tracer(evm, tracer)
             state.set_tx_context(tx.hash(), i)
             used = [0]
             receipt = apply_transaction(
-                self.b.chain_config, chain, evm, gp, state, blk.header, tx, used
+                self.b.chain_config, chain, evm, gp, tx_state, blk.header, tx,
+                used
             )
             if traced:
                 if isinstance(tracer, StructLogger):
@@ -165,6 +269,12 @@ class DebugAPI:
         name = config.get("tracer")
         if name == "callTracer":
             return CallTracer
+        if name == "4byteTracer":
+            return FourByteTracer
+        if name == "prestateTracer":
+            return PrestateTracer
+        if name:
+            raise RPCError(-32000, f"unknown tracer {name!r}")
         return lambda: StructLogger(
             with_memory=config.get("enableMemory", False),
             limit=config.get("limit", 0),

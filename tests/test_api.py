@@ -202,6 +202,36 @@ class TestDebugTracers:
         assert trace["type"] == "CALL"
         assert trace["to"] == "0x" + (b"\xee" * 20).hex()
 
+    def test_4byte_tracer(self, live_vm):
+        vm, server, _, (t2, b2) = live_vm
+        trace = rpc(server, "debug_traceTransaction", "0x" + t2.hash().hex(),
+                    {"tracer": "4byteTracer"})
+        # the emitter call carries calldata only if the tx had data; the
+        # fixture's tx may be plain — then the dict is empty but valid
+        assert isinstance(trace, dict)
+        for k, v in trace.items():
+            assert k.startswith("0x") and "-" in k and v >= 1
+
+    def test_prestate_tracer(self, live_vm):
+        vm, server, _, (t2, b2) = live_vm
+        trace = rpc(server, "debug_traceTransaction", "0x" + t2.hash().hex(),
+                    {"tracer": "prestateTracer"})
+        sender = "0x" + ADDR.hex()
+        emitter = "0x" + (b"\xee" * 20).hex()
+        assert sender in trace and emitter in trace
+        # pre-tx balance/nonce of the sender, code of the callee
+        assert int(trace[sender]["balance"], 16) > 0
+        assert trace[emitter]["code"].startswith("0x60")
+        # the emitter STOREs CALLVALUE? it only MSTOREs — storage absent
+        assert "storage" not in trace[emitter] or isinstance(
+            trace[emitter]["storage"], dict)
+
+    def test_unknown_tracer_rejected(self, live_vm):
+        vm, server, _, (t2, b2) = live_vm
+        with pytest.raises(RuntimeError):
+            rpc(server, "debug_traceTransaction", "0x" + t2.hash().hex(),
+                {"tracer": "jsTracer9000"})
+
     def test_trace_block(self, live_vm):
         vm, server, _, (t2, b2) = live_vm
         traces = rpc(server, "debug_traceBlockByNumber", "0x2")

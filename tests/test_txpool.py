@@ -1,0 +1,137 @@
+"""TxPool locals journal + price-eviction tests (reference surfaces:
+core/txpool/txpool.go pricedList eviction :259-764, journal.go replay,
+accountSet locals)."""
+
+import pytest
+
+from coreth_tpu import params
+from coreth_tpu.consensus.dummy import new_dummy_engine
+from coreth_tpu.core.blockchain import BlockChain, CacheConfig
+from coreth_tpu.core.genesis import Genesis, GenesisAccount
+from coreth_tpu.core.txpool import (
+    TxJournal,
+    TxPool,
+    TxPoolConfig,
+    TxPoolError,
+)
+from coreth_tpu.core.types import Signer, Transaction
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.state.database import Database
+from coreth_tpu.trie.triedb import TrieDatabase
+
+KEYS = [i.to_bytes(1, "big") * 32 for i in range(1, 9)]
+ADDRS = [priv_to_address(k) for k in KEYS]
+SIGNER = Signer(43112)
+BASE_FEE = params.APRICOT_PHASE3_INITIAL_BASE_FEE
+
+
+def make_chain():
+    diskdb = MemoryDB()
+    genesis = Genesis(
+        config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+        alloc={a: GenesisAccount(balance=10**24) for a in ADDRS},
+    )
+    return BlockChain(
+        diskdb, CacheConfig(), params.TEST_CHAIN_CONFIG, genesis,
+        new_dummy_engine(), state_database=Database(TrieDatabase(diskdb)),
+    )
+
+
+def tx(key_i, nonce, tip=10**9, fee_mult=2):
+    t = Transaction(type=2, chain_id=43112, nonce=nonce,
+                    max_fee=BASE_FEE * fee_mult, max_priority_fee=tip,
+                    gas=21000, to=b"\xdd" * 20, value=1)
+    return SIGNER.sign(t, KEYS[key_i])
+
+
+class TestPriceEviction:
+    def _full_pool(self, slots=4):
+        chain = make_chain()
+        pool = TxPool(TxPoolConfig(global_slots=slots), params.TEST_CHAIN_CONFIG,
+                      chain)
+        # fill with remotes at increasing fee caps
+        for i in range(slots):
+            pool.add_remote(tx(i, 0, fee_mult=2 + i))
+        assert pool.stats()[0] == slots
+        return chain, pool
+
+    def test_outbidding_remote_evicts_cheapest(self):
+        chain, pool = self._full_pool()
+        cheapest = tx(0, 0, fee_mult=2)   # key 0 sent the cheapest
+        rich = tx(5, 0, fee_mult=50)
+        pool.add_remote(rich)             # evicts, does not raise
+        assert pool.has(rich.hash())
+        assert not pool.has(cheapest.hash())
+        assert pool.stats()[0] == 4       # pool size unchanged
+        chain.stop()
+
+    def test_underbidding_remote_rejected(self):
+        chain, pool = self._full_pool()
+        with pytest.raises(TxPoolError, match="pool full"):
+            pool.add_remote(tx(5, 0, fee_mult=2))  # ties the cheapest: loses
+        chain.stop()
+
+    def test_local_txs_never_evicted(self):
+        chain = make_chain()
+        pool = TxPool(TxPoolConfig(global_slots=2), params.TEST_CHAIN_CONFIG,
+                      chain)
+        local = tx(0, 0, fee_mult=2)      # cheapest but LOCAL
+        pool.add_local(local)
+        pool.add_remote(tx(1, 0, fee_mult=3))
+        rich = tx(2, 0, fee_mult=50)
+        pool.add_remote(rich)             # must evict the remote, not local
+        assert pool.has(local.hash())
+        assert pool.has(rich.hash())
+        chain.stop()
+
+    def test_local_bypasses_full_pool(self):
+        chain, pool = self._full_pool()
+        extra = tx(6, 0, fee_mult=2)      # cheap, but local bypasses caps
+        pool.add_local(extra)
+        assert pool.has(extra.hash())
+        chain.stop()
+
+
+class TestJournal:
+    def test_journal_roundtrip(self, tmp_path):
+        path = str(tmp_path / "transactions.rlp")
+        chain = make_chain()
+        cfg = TxPoolConfig(journal=path)
+        pool = TxPool(cfg, params.TEST_CHAIN_CONFIG, chain)
+        t0, t1 = tx(0, 0), tx(0, 1)
+        pool.add_local(t0)
+        pool.add_local(t1)
+        pool.add_remote(tx(1, 0))  # remotes never hit the journal
+
+        # "restart": a new pool over the same chain + journal path
+        pool2 = TxPool(cfg, params.TEST_CHAIN_CONFIG, chain)
+        assert pool2.has(t0.hash()) and pool2.has(t1.hash())
+        assert not pool2.has(tx(1, 0).hash())
+        assert ADDRS[0] in pool2.locals
+        chain.stop()
+
+    def test_journal_survives_truncated_tail(self, tmp_path):
+        path = str(tmp_path / "transactions.rlp")
+        j = TxJournal(path)
+        t0 = tx(0, 0)
+        j.insert(t0)
+        with open(path, "ab") as f:
+            f.write(b"\xf9\x01")  # torn write
+        got = []
+        assert j.load(got.append) == 1
+        assert got[0].hash() == t0.hash()
+
+    def test_rotate_compacts(self, tmp_path):
+        import os
+
+        path = str(tmp_path / "transactions.rlp")
+        j = TxJournal(path)
+        for n in range(5):
+            j.insert(tx(0, n))
+        size_before = os.path.getsize(path)
+        j.rotate([tx(0, 4)])
+        assert os.path.getsize(path) < size_before
+        got = []
+        j.load(got.append)
+        assert len(got) == 1 and got[0].nonce == 4
