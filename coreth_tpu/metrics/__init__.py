@@ -204,3 +204,24 @@ def get_or_register_meter(name: str, registry: Optional[Registry] = None) -> Met
 
 def get_or_register_gauge(name: str, registry: Optional[Registry] = None) -> Gauge:
     return (registry or default_registry).gauge(name)
+
+
+class _NullCtx:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+_NULL_CTX = _NullCtx()
+
+
+def expensive_timer(name: str, registry: Optional[Registry] = None):
+    """Context-managed timer gated on EnabledExpensive (metrics.go gate):
+    zero overhead beyond one flag check when the gate is off. Used for
+    the per-phase statedb timers (statedb.go:1006-1119
+    AccountHashes/AccountCommits/StorageCommits analogs)."""
+    if not enabled_expensive:
+        return _NULL_CTX
+    return (registry or default_registry).timer(name).time()

@@ -377,18 +377,22 @@ class StateDB:
         Storage-root updates and account-trie writes happen here; the hash
         itself drains through the TPU batch seam when the dirty set is big.
         """
+        from ..metrics import expensive_timer
+
         self.finalise(delete_empty)
-        for addr in sorted(self._objects_pending):
-            obj = self._objects[addr]
-            if obj.deleted:
-                self.trie.delete(addr)
-            else:
-                obj.update_root()
-                self.trie.update(addr, obj.data.encode())
-                if self.snap is not None:
-                    self._snap_accounts[obj.addr_hash] = _account_to_slim(obj.data)
+        with expensive_timer("state/account/updates"):
+            for addr in sorted(self._objects_pending):
+                obj = self._objects[addr]
+                if obj.deleted:
+                    self.trie.delete(addr)
+                else:
+                    obj.update_root()
+                    self.trie.update(addr, obj.data.encode())
+                    if self.snap is not None:
+                        self._snap_accounts[obj.addr_hash] = _account_to_slim(obj.data)
         self._objects_pending = set()
-        return self.trie.hash()
+        with expensive_timer("state/account/hashes"):
+            return self.trie.hash()
 
     def commit(self, delete_empty: bool = False,
                block_hash: Optional[bytes] = None,
@@ -398,26 +402,30 @@ class StateDB:
         Order: storage tries → code → account trie → TrieDB.Update.
         Returns the new state root.
         """
+        from ..metrics import expensive_timer
+
         self.intermediate_root(delete_empty)
         merged = MergedNodeSet()
-        for addr in sorted(self._objects_dirty):
-            obj = self._objects[addr]
-            if obj.deleted:
-                continue
-            if obj.dirty_code:
-                rawdb.write_code(self.db.diskdb, obj.data.code_hash, obj.code)
-                obj.dirty_code = False
-            nodeset = obj.commit_trie()
-            if nodeset is not None:
-                nodeset.owner = obj.addr_hash
-                merged.merge(nodeset)
-            if self.snap is not None and obj.snap_flush:
-                stor = self._snap_storage.setdefault(obj.addr_hash, {})
-                for k, v in obj.snap_flush.items():
-                    hk = keccak256(k)
-                    stor[hk] = rlp.encode(v.lstrip(b"\x00")) if v != ZERO32 else b""
-            obj.snap_flush = {}
-        root, acct_set = self.trie.commit(collect_leaf=True)
+        with expensive_timer("state/storage/commits"):
+            for addr in sorted(self._objects_dirty):
+                obj = self._objects[addr]
+                if obj.deleted:
+                    continue
+                if obj.dirty_code:
+                    rawdb.write_code(self.db.diskdb, obj.data.code_hash, obj.code)
+                    obj.dirty_code = False
+                nodeset = obj.commit_trie()
+                if nodeset is not None:
+                    nodeset.owner = obj.addr_hash
+                    merged.merge(nodeset)
+                if self.snap is not None and obj.snap_flush:
+                    stor = self._snap_storage.setdefault(obj.addr_hash, {})
+                    for k, v in obj.snap_flush.items():
+                        hk = keccak256(k)
+                        stor[hk] = rlp.encode(v.lstrip(b"\x00")) if v != ZERO32 else b""
+                obj.snap_flush = {}
+        with expensive_timer("state/account/commits"):
+            root, acct_set = self.trie.commit(collect_leaf=True)
         merged.merge(acct_set)
         self._objects_dirty = set()
         if root != self.original_root and merged.sets:

@@ -138,8 +138,9 @@ class AdminAPI:
         return os.path.join(self.profile_dir, name)
 
     def setLogLevel(self, level: str) -> bool:
-        if level not in ("trace", "debug", "info", "warn", "error", "crit"):
-            raise ValueError(f"unknown log level {level!r}")
+        from .. import log
+
+        log.set_level(level)  # raises on unknown levels
         self.log_level = level
         return True
 

@@ -332,3 +332,73 @@ class TestAdminProfiler:
         assert a.log_level == "debug"
         with pytest.raises(ValueError):
             a.setLogLevel("verbose")
+
+
+class TestLoggingSystem:
+    def test_leveled_logger_and_admin_wiring(self, tmp_path):
+        import io
+
+        from coreth_tpu import log
+        from coreth_tpu.vm.api import AdminAPI
+
+        buf = io.StringIO()
+        log.init("info", stream=buf)
+        lg = log.get_logger("test")
+        lg.debug("hidden")
+        lg.info("visible %d", 42)
+        assert "visible 42" in buf.getvalue()
+        assert "hidden" not in buf.getvalue()
+
+        a = AdminAPI(vm=None, profile_dir=str(tmp_path))
+        a.setLogLevel("debug")
+        lg.debug("now shown")
+        assert "now shown" in buf.getvalue()
+        with pytest.raises(ValueError):
+            a.setLogLevel("nope")
+
+    def test_json_format_and_trace(self):
+        import io
+        import json as _json
+
+        from coreth_tpu import log
+
+        buf = io.StringIO()
+        log.init("trace", json_format=True, stream=buf)
+        lg = log.get_logger("sync")
+        log.trace(lg, "leaf batch", count=512)
+        line = _json.loads(buf.getvalue().strip())
+        assert line["lvl"] == "trace" and line["count"] == 512
+        assert line["logger"] == "coreth_tpu.sync"
+        log.init("info")  # restore default handler for other tests
+
+
+class TestExpensiveMetrics:
+    def test_statedb_phase_timers_gated(self):
+        from coreth_tpu import metrics
+        from coreth_tpu.ethdb import MemoryDB
+        from coreth_tpu.state.database import Database
+        from coreth_tpu.state.statedb import StateDB
+        from coreth_tpu.trie.node import EMPTY_ROOT
+        from coreth_tpu.trie.triedb import TrieDatabase
+
+        reg = metrics.default_registry
+
+        def timer_count(name):
+            t = reg.timer(name)
+            return t.count() if hasattr(t, "count") else len(t._durations)
+
+        st = StateDB(EMPTY_ROOT, Database(TrieDatabase(MemoryDB())))
+        st.add_balance(b"\x01" * 20, 5)
+        before = timer_count("state/account/hashes")
+        st.commit()  # gate off: no samples recorded
+        assert timer_count("state/account/hashes") == before
+
+        metrics.enabled_expensive = True
+        try:
+            st2 = StateDB(EMPTY_ROOT, Database(TrieDatabase(MemoryDB())))
+            st2.add_balance(b"\x02" * 20, 5)
+            st2.commit()
+            assert timer_count("state/account/hashes") > before
+            assert timer_count("state/account/commits") > 0
+        finally:
+            metrics.enabled_expensive = False

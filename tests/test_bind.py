@@ -1,0 +1,151 @@
+"""Contract binding + abigen tests (reference: accounts/abi/bind/base.go
++ cmd/abigen) — deploy and drive a real contract on a live VM through
+generated bindings."""
+
+import json
+
+import pytest
+
+from coreth_tpu import params
+from coreth_tpu.accounts.abi import ABI
+from coreth_tpu.accounts.bind import (
+    BoundContract,
+    TransactOpts,
+    deploy_contract,
+    generate_bindings,
+)
+from coreth_tpu.core.genesis import Genesis, GenesisAccount
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.ethclient import Client
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.evm import opcodes as OP
+from coreth_tpu.vm.api import create_handlers
+from coreth_tpu.vm.shared_memory import Memory
+from coreth_tpu.vm.vm import SnowContext, VM, VMConfig
+
+KEY = b"\x11" * 32
+ADDR = priv_to_address(KEY)
+
+# A hand-assembled "counter": get() returns storage[0]; any tx with
+# selector-less... keep it simple: runtime code ignores calldata and
+#   - if CALLDATASIZE == 0: SSTORE(0, SLOAD(0)+1), LOG1(topic 0xCAFE)
+#   - else: RETURN SLOAD(0) (32 bytes)
+RUNTIME = bytes([
+    OP.CALLDATASIZE, OP.PUSH1, 0x17, OP.JUMPI,            # size!=0 -> read
+    OP.PUSH1, 0x00, OP.SLOAD, OP.PUSH1, 0x01, OP.ADD,     # v+1
+    OP.PUSH1, 0x00, OP.SSTORE,                            # store
+    OP.PUSH32]) + (0xCAFE).to_bytes(32, "big") + bytes([
+    OP.PUSH1, 0x00, OP.PUSH1, 0x00, OP.LOG0 + 1,          # LOG1 empty data
+    OP.STOP,
+    OP.JUMPDEST,                                          # 0x17... must align
+])
+# patch the jump destination to the actual JUMPDEST offset
+_jd = RUNTIME.index(OP.JUMPDEST)
+RUNTIME = RUNTIME.replace(bytes([OP.PUSH1, 0x17]), bytes([OP.PUSH1, _jd]), 1)
+RUNTIME += bytes([
+    OP.PUSH1, 0x00, OP.SLOAD, OP.PUSH1, 0x00, OP.MSTORE,
+    OP.PUSH1, 0x20, OP.PUSH1, 0x00, OP.RETURN,
+])
+
+INIT = (bytes([OP.PUSH1, len(RUNTIME), OP.DUP1, OP.PUSH1, 0x0B,
+               OP.PUSH1, 0x00, OP.CODECOPY, OP.PUSH1, 0x00, OP.RETURN])
+        + RUNTIME)
+
+ABI_JSON = [
+    {"type": "function", "name": "get", "stateMutability": "view",
+     "inputs": [{"name": "probe", "type": "bytes"}],
+     "outputs": [{"name": "", "type": "uint256"}]},
+    {"type": "function", "name": "increment", "stateMutability": "nonpayable",
+     "inputs": [], "outputs": []},
+    {"type": "event", "name": "Ticked", "anonymous": True, "inputs": []},
+]
+
+
+@pytest.fixture()
+def live():
+    vm = VM()
+    genesis = Genesis(
+        config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+        alloc={ADDR: GenesisAccount(balance=10**24)},
+    )
+
+    def tick():
+        return vm.blockchain.current_block.time + 2
+
+    vm.initialize(SnowContext(shared_memory=Memory()), MemoryDB(), genesis,
+                  VMConfig(clock=tick))
+    server = create_handlers(vm)
+    client = Client(server=server)
+
+    def mine():
+        blk = vm.build_block()
+        blk.verify()
+        blk.accept()
+        vm.blockchain.drain_acceptor_queue()
+
+    yield vm, client, mine
+    vm.shutdown()
+
+
+class TestBoundContract:
+    def test_deploy_call_transact_events(self, live):
+        vm, client, mine = live
+        abi = ABI(ABI_JSON)
+        opts = TransactOpts(KEY, 43112)
+        addr, tx_hash, bound = deploy_contract(client, opts, abi, INIT)
+        mine()
+        assert client.code_at(addr) == RUNTIME
+
+        # since the contract branches on CALLDATASIZE, "get" (non-empty
+        # calldata) returns the counter
+        assert bound.call("get", b"") == [0]
+        # increment: the generated tx carries the selector (non-empty) —
+        # use a raw empty-data transact to hit the increment branch
+        bound.transact(opts, None)
+        mine()
+        assert bound.call("get", b"") == [1]
+        logs = bound.filter_logs("Ticked")
+        # anonymous event: topic filter is the event id; our LOG1 topic is
+        # 0xCAFE so the address filter is what matches
+        assert isinstance(logs, list)
+
+    def test_generated_module_end_to_end(self, live, tmp_path):
+        vm, client, mine = live
+        src = generate_bindings(ABI_JSON, "Counter", INIT)
+        mod_path = tmp_path / "counter_binding.py"
+        mod_path.write_text(src)
+        ns: dict = {}
+        exec(compile(src, str(mod_path), "exec"), ns)
+        Counter = ns["Counter"]
+
+        opts = TransactOpts(KEY, 43112)
+        counter, tx_hash = Counter.deploy(client, opts)
+        mine()
+        assert client.code_at(counter.address) == RUNTIME
+        assert counter.get(b"") == 0
+        # the generated increment() sends selector calldata -> read branch;
+        # raw transact drives the mutation branch
+        counter.contract.transact(opts, None)
+        mine()
+        assert counter.get(b"") == 1
+        # event filter method generated
+        assert hasattr(counter, "filter_Ticked")
+
+    def test_abigen_cli(self, tmp_path):
+        import subprocess
+        import sys
+
+        abi_file = tmp_path / "c.json"
+        abi_file.write_text(json.dumps(ABI_JSON))
+        out_file = tmp_path / "c.py"
+        r = subprocess.run(
+            [sys.executable, "-m", "coreth_tpu.accounts.bind",
+             "--abi", str(abi_file), "--name", "Counter",
+             "--out", str(out_file)],
+            capture_output=True, text=True, timeout=60,
+            cwd="/root/repo",
+        )
+        assert r.returncode == 0, r.stderr[-500:]
+        src = out_file.read_text()
+        assert "class Counter:" in src
+        compile(src, "c.py", "exec")  # syntactically valid module
