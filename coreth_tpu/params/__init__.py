@@ -245,4 +245,31 @@ def avalanche_mainnet_chain_config() -> ChainConfig:
     )
 
 
+def avalanche_fuji_chain_config() -> ChainConfig:
+    """Fuji testnet cadence (params/config.go:80-105 timestamps)."""
+    return ChainConfig(
+        chain_id=43113,
+        apricot_phase1_time=1616767200,   # 2021-03-26T14:00Z
+        apricot_phase2_time=1620223200,   # 2021-05-05T14:00Z
+        apricot_phase3_time=1629140400,   # 2021-08-16T19:00Z
+        apricot_phase4_time=1631826000,   # 2021-09-16T21:00Z
+        apricot_phase5_time=1637766000,   # 2021-11-24T15:00Z
+        apricot_phase_pre6_time=1662494400,   # 2022-09-06T20:00Z
+        apricot_phase6_time=1662494400,       # 2022-09-06T20:00Z
+        apricot_phase_post6_time=1662530400,  # 2022-09-07T06:00Z
+        banff_time=1664805600,            # 2022-10-03T14:00Z
+        cortina_time=1680793200,          # 2023-04-06T15:00Z
+        d_upgrade_time=None,
+    )
+
+
+def chain_config_for_network(network_id: int) -> ChainConfig:
+    """Genesis/network -> fork schedule selection (vm.go:383-403)."""
+    if network_id == 1:       # avalanche mainnet network id
+        return avalanche_mainnet_chain_config()
+    if network_id == 5:       # fuji network id
+        return avalanche_fuji_chain_config()
+    return avalanche_local_chain_config()
+
+
 TEST_CHAIN_CONFIG = avalanche_local_chain_config()

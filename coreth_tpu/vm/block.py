@@ -54,11 +54,21 @@ class VMBlock:
     # --- lifecycle --------------------------------------------------------
 
     def verify(self, writes: bool = True) -> None:
-        """Verify (block.go:229-253): syntactic checks + InsertBlockManual."""
+        """Verify (block.go:229-253): syntactic checks + InsertBlockManual
+        + pinning the block's pending atomic state (atomic_backend.go)."""
         self.syntactic_verify()
         for atx in self.atomic_txs:
             atx.semantic_verify(self.vm, self.eth_block.base_fee)
-        self.vm.blockchain.insert_block_manual(self.eth_block, writes)
+        if writes:
+            # conflict-check against pending ancestors BEFORE the chain
+            # insert so a double-spending fork never lands in the chain
+            self.vm.atomic_backend.insert_block(self)
+        try:
+            self.vm.blockchain.insert_block_manual(self.eth_block, writes)
+        except Exception:
+            if writes:
+                self.vm.atomic_backend.reject(self)
+            raise
         if writes:
             self.vm.add_verified_block(self)
 
@@ -68,18 +78,19 @@ class VMBlock:
         syntactic_verify(self.vm, self)
 
     def accept(self) -> None:
-        """Accept (block.go:136-169)."""
+        """Accept (block.go:136-169): chain accept + the block's pending
+        atomic state applied in one repository/shared-memory batch."""
         vm = self.vm
         vm.blockchain.accept(self.eth_block)
         self.status = BlockStatus.ACCEPTED
         vm.set_last_accepted(self)
-        for atx in self.atomic_txs:
-            vm.atomic_backend_apply(self, atx)
+        vm.atomic_backend.accept(self)
         vm.forget_verified_block(self.id())
 
     def reject(self) -> None:
         """Reject (block.go:173-191): losing fork; re-issue atomic txs."""
         vm = self.vm
+        vm.atomic_backend.reject(self)
         for atx in self.atomic_txs:
             try:
                 vm.mempool.add(atx, force=True)

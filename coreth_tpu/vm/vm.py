@@ -169,6 +169,12 @@ class VM:
 
         self._verified_blocks: Dict[bytes, VMBlock] = {}
         self._accepted_atomic_ops: List = []
+
+        # per-verified-block pending atomic state + tx repository
+        # (atomic_backend.go / atomic_tx_repository.go)
+        from .atomic_backend import AtomicBackend
+
+        self.atomic_backend = AtomicBackend(self)
         genesis_vmb = VMBlock(self, self.blockchain.genesis_block)
         genesis_vmb.status = BlockStatus.ACCEPTED
         self.last_accepted_vm_block = genesis_vmb
@@ -335,12 +341,9 @@ class VM:
         self.last_accepted_vm_block = vmb
 
     def atomic_backend_apply(self, vmb: VMBlock, tx: Tx) -> None:
-        """Accept-path shared memory commit (block.go:164-168): apply the
-        tx's requests atomically with the VM db batch."""
+        """Back-compat single-tx apply; the accept path now drains whole
+        blocks through AtomicBackend.accept (atomic_backend.py)."""
         chain, requests = tx.atomic_ops()
-        # the tx index commits atomically with the shared-memory ops, like
-        # the reference's versiondb commit batch (block.go:164-168); the
-        # "Atx" prefix lives outside every 1-byte rawdb/snapshot namespace
         batch = self.blockchain.diskdb.new_batch()
         batch.put(
             ATOMIC_TX_INDEX_PREFIX + tx.id(),

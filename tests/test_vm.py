@@ -329,3 +329,114 @@ class TestVMConfig:
         assert vm.config.commit_interval == 2048
         assert vm.full_config.commit_interval == 2048
         vm.shutdown()
+
+
+class TestAtomicBackend:
+    """Per-verified-block pending atomic state + repository
+    (atomic_backend.go / atomic_tx_repository.go; VERDICT round-1
+    missing #9)."""
+
+    def test_pending_ancestor_conflict_rejected(self):
+        """Two blocks in ONE unaccepted chain must not consume the same
+        UTXO: the child's verify fails against the pending parent."""
+        from coreth_tpu.vm.atomic_backend import AtomicBackendError
+
+        vm, mem = genesis_vm()
+        utxo = make_import_utxo(amount=5 * 10**9)
+        put_utxo_in_shared_memory(mem, utxo)
+
+        def import_tx():
+            imp = ImportTx(
+                network_id=1337, blockchain_id=C_CHAIN, source_chain=X_CHAIN,
+                imported_inputs=[utxo],
+                outs=[EVMOutput(address=DEST, amount=4 * 10**9, asset_id=AVAX)],
+            )
+            t = Tx(imp)
+            t.sign([KEY])
+            return t
+
+        vm.issue_atomic_tx(import_tx())
+        blk1 = vm.build_block()
+        blk1.verify()  # pending, not accepted
+
+        # forge a child block carrying a second spend of the SAME utxo
+        # (mempool would refuse it, so drive the backend directly)
+        dup = import_tx()
+        blk1_state = vm.atomic_backend.pending_for(blk1.id())
+        assert blk1_state is not None and len(blk1_state.consumed) == 1
+
+        class _FakeChild:
+            def __init__(s):
+                s.atomic_txs = [dup]
+                s.eth_block = type("E", (), {
+                    "parent_hash": blk1.id()})()
+
+            def id(s):
+                return b"\xfe" * 32
+
+            def height(s):
+                return blk1.height() + 1
+
+        with pytest.raises(AtomicBackendError, match="conflicting"):
+            vm.atomic_backend.insert_block(_FakeChild())
+
+        blk1.accept()
+        vm.blockchain.drain_acceptor_queue()
+        # accepted: pending state gone, repository indexed
+        assert vm.atomic_backend.pending_for(blk1.id()) is None
+        repo = vm.atomic_backend.repo
+        h_txs = repo.tx_ids_at_height(blk1.height())
+        assert len(h_txs) == 1
+        height, _tx_bytes = repo.get_by_id(h_txs[0])
+        assert height == blk1.height()
+        vm.shutdown()
+
+    def test_reject_releases_pending_utxos(self):
+        vm, mem = genesis_vm()
+        utxo = make_import_utxo(amount=5 * 10**9)
+        put_utxo_in_shared_memory(mem, utxo)
+        imp = ImportTx(
+            network_id=1337, blockchain_id=C_CHAIN, source_chain=X_CHAIN,
+            imported_inputs=[utxo],
+            outs=[EVMOutput(address=DEST, amount=4 * 10**9, asset_id=AVAX)],
+        )
+        tx = Tx(imp)
+        tx.sign([KEY])
+        vm.issue_atomic_tx(tx)
+        blk = vm.build_block()
+        blk.verify()
+        assert vm.atomic_backend.pending_for(blk.id()) is not None
+        blk.reject()
+        assert vm.atomic_backend.pending_for(blk.id()) is None
+        vm.shutdown()
+
+    def test_bonus_block_repair(self):
+        """A tx double-indexed at a bonus height re-points to its
+        canonical (lowest) height and the bonus row disappears."""
+        from coreth_tpu.vm.atomic_backend import AtomicTxRepository
+
+        vm, mem = genesis_vm()
+        utxo = make_import_utxo()
+        imp = ImportTx(
+            network_id=1337, blockchain_id=C_CHAIN, source_chain=X_CHAIN,
+            imported_inputs=[utxo],
+            outs=[EVMOutput(address=DEST, amount=9 * 10**8, asset_id=AVAX)],
+        )
+        tx = Tx(imp)
+        tx.sign([KEY])
+
+        repo = AtomicTxRepository(MemoryDB())
+        b = repo.diskdb.new_batch()
+        repo.write(b, 10, [tx])     # canonical
+        repo.write(b, 55, [tx])     # bonus duplicate
+        b.write()
+        assert repo.get_by_id(tx.id())[0] == 55  # last write won
+
+        repaired = repo.repair_bonus_blocks({55})
+        assert repaired == 1
+        assert repo.tx_ids_at_height(55) == []
+        assert repo.tx_ids_at_height(10) == [tx.id()]
+        assert repo.get_by_id(tx.id())[0] == 10
+        # idempotent
+        assert repo.repair_bonus_blocks({55}) == 0
+        vm.shutdown()
