@@ -52,12 +52,22 @@ def main():
     enable_compilation_cache()
     from coreth_tpu.native.mpt import plan_commit
 
+    # CORETH_TPU_BENCH_KERNEL=pallas swaps the per-segment keccak for the
+    # Pallas VMEM-resident kernel on lane counts its grid tiles (%1024);
+    # default is the XLA scanned-block kernel
+    planned = None
+    if os.environ.get("CORETH_TPU_BENCH_KERNEL") == "pallas":
+        from coreth_tpu.ops.keccak_pallas import staged_seg_impl
+        from coreth_tpu.ops.keccak_planned import PlannedCommit
+
+        planned = PlannedCommit(seg_impl=staged_seg_impl())
+
     keys, vals, off = build_workload(n_leaves)
 
     # warm-up: compile/cache the device programs for this shape class
     plan = plan_commit(keys, vals, off)
     nodes = plan.num_nodes
-    root_dev = plan.execute_planned()
+    root_dev = plan.execute_planned(planned)
 
     def run_cpu():
         p = plan_commit(keys, vals, off)
@@ -65,7 +75,7 @@ def main():
 
     def run_tpu():
         p = plan_commit(keys, vals, off)
-        return p.execute_planned()
+        return p.execute_planned(planned)
 
     def best(fn):
         b, root = float("inf"), None

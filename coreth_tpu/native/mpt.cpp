@@ -26,6 +26,7 @@
 #include <cstring>
 #include <thread>
 #include <vector>
+#include <array>
 #include <algorithm>
 
 namespace {
@@ -408,34 +409,64 @@ void layout(Plan& p) {
   p.nblocks.assign(gstart, 1);
   p.msg_len.assign(gstart, 0);
 
-  // write every hashed node's RLP into its padded row + collect patches
+  // write every hashed node's RLP into its padded row + collect patches;
+  // rows are disjoint, so big segments fan out across hardware threads
+  // (each thread keeps a local patch list, merged back in lane order so
+  // the exported tables stay deterministic)
   p.total_patches = 0;
+  int hw = std::max(1u, std::thread::hardware_concurrency());
   for (auto& seg : p.segs) {
     int width = seg.blocks * kRate;
-    std::vector<std::pair<int32_t, int32_t>> patches;  // (global off in row, cid)
-    std::vector<std::pair<int32_t, int32_t>> lane_patches;
     seg.pl.clear();
     seg.po.clear();
     seg.pc.clear();
-    for (int lane = 0; lane < (int)seg.node_of_lane.size(); ++lane) {
-      int32_t id = seg.node_of_lane[lane];
-      Node& nd = p.nodes[id];
-      uint8_t* row = p.flat.data() + seg.byte_base + (int64_t)lane * width;
-      patches.clear();
-      Writer w{p, patches, row};
-      uint8_t* out = row;
-      w.write_node(id, out);
-      int len = (int)(out - row);
-      // keccak pad10*1
-      row[len] ^= 0x01;
-      row[width - 1] ^= 0x80;
-      int32_t g = seg.gstart + lane;
-      p.nblocks[g] = seg.blocks;
-      p.msg_len[g] = len;
-      for (auto& pr : patches) {
-        seg.pl.push_back(lane);
-        seg.po.push_back(pr.first);
-        seg.pc.push_back(p.nodes[pr.second].lane);  // packed child row
+    int real = (int)seg.node_of_lane.size();
+
+    auto write_range = [&](int from, int to,
+                           std::vector<std::array<int32_t, 3>>& out_patches) {
+      std::vector<std::pair<int32_t, int32_t>> patches;
+      for (int lane = from; lane < to; ++lane) {
+        int32_t id = seg.node_of_lane[lane];
+        uint8_t* row = p.flat.data() + seg.byte_base + (int64_t)lane * width;
+        patches.clear();
+        Writer w{p, patches, row};
+        uint8_t* out = row;
+        w.write_node(id, out);
+        int len = (int)(out - row);
+        // keccak pad10*1
+        row[len] ^= 0x01;
+        row[width - 1] ^= 0x80;
+        int32_t g = seg.gstart + lane;
+        p.nblocks[g] = seg.blocks;
+        p.msg_len[g] = len;
+        for (auto& pr : patches)
+          out_patches.push_back({lane, pr.first, p.nodes[pr.second].lane});
+      }
+    };
+
+    if (hw > 1 && real >= 2048) {
+      int t = std::min(hw, 16);
+      int chunk = (real + t - 1) / t;
+      std::vector<std::vector<std::array<int32_t, 3>>> locals(t);
+      std::vector<std::thread> pool;
+      for (int i = 0; i < t; ++i)
+        pool.emplace_back(write_range, i * chunk,
+                          std::min(real, (i + 1) * chunk),
+                          std::ref(locals[i]));
+      for (auto& th : pool) th.join();
+      for (auto& lp : locals)
+        for (auto& e : lp) {
+          seg.pl.push_back(e[0]);
+          seg.po.push_back(e[1]);
+          seg.pc.push_back(e[2]);
+        }
+    } else {
+      std::vector<std::array<int32_t, 3>> lp;
+      write_range(0, real, lp);
+      for (auto& e : lp) {
+        seg.pl.push_back(e[0]);
+        seg.po.push_back(e[1]);
+        seg.pc.push_back(e[2]);
       }
     }
     // pad patch table to pow2 >= 16; writes land in the scratch lane

@@ -197,6 +197,67 @@ class TrieDatabase:
             self._cleans[node_hash] = c.blob
             self._clean_size += len(c.blob)
 
+    def save_clean_cache(self, path: str) -> int:
+        """Journal the clean cache to disk (trie/database_wrap.go:195-236
+        saveCache): a warm restart skips re-reading hot nodes from the KV
+        store. Atomic (tmp+rename); returns entries written."""
+        import os
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".")
+        n = 0
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(b"CTCJ\x01")  # magic + version
+                for h, blob in self._cleans.items():
+                    f.write(h)
+                    f.write(len(blob).to_bytes(4, "big"))
+                    f.write(blob)
+                    n += 1
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return n
+
+    def load_clean_cache(self, path: str) -> int:
+        """Restore a journaled clean cache; entries are verified by hash
+        (a corrupt/stale journal can never poison reads). Returns entries
+        loaded; 0 for missing/invalid journals."""
+        import os
+
+        from ..crypto import keccak256
+
+        if not os.path.exists(path):
+            return 0
+        with open(path, "rb") as f:
+            blob = f.read()
+        if blob[:5] != b"CTCJ\x01":
+            return 0
+        n = 0
+        pos = 5
+        while pos + 36 <= len(blob):
+            h = blob[pos:pos + 32]
+            ln = int.from_bytes(blob[pos + 32:pos + 36], "big")
+            pos += 36
+            if pos + ln > len(blob):
+                break  # torn tail
+            node = blob[pos:pos + ln]
+            pos += ln
+            if keccak256(node) != h:
+                continue  # verify-or-skip, never trust the file
+            if h in self._cleans:
+                continue  # already resident: size must not double-count
+            if self._clean_size + ln > self._clean_limit:
+                break
+            self._cleans[h] = node
+            self._clean_size += ln
+            n += 1
+        return n
+
     def cap(self, limit_bytes: int) -> None:
         """Flush oldest nodes to disk until memory usage <= limit."""
         if self._dirty_size <= limit_bytes:

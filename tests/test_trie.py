@@ -316,3 +316,90 @@ def test_triedb_dereference_gc():
     assert tdb.dirty_size > 0
     tdb.dereference(root)
     assert tdb.dirty_size == 0  # fully GC'd
+
+
+class TestCleanCacheJournal:
+    """Clean-cache persistence across restarts
+    (trie/database_wrap.go:195-236 saveCache/loadSnapshot analog)."""
+
+    def test_roundtrip_and_verification(self, tmp_path):
+        import random
+
+        from coreth_tpu.ethdb import MemoryDB
+        from coreth_tpu.trie.triedb import TrieDatabase
+
+        from coreth_tpu.trie.trienode import MergedNodeSet
+        from coreth_tpu.trie.node import EMPTY_ROOT
+
+        diskdb = MemoryDB()
+        tdb = TrieDatabase(diskdb)
+        t = tdb.open_trie()
+        rng = random.Random(4)
+        for _ in range(200):
+            t.update(rng.randbytes(32), rng.randbytes(60))
+        root, nodeset = t.commit()
+        merged = MergedNodeSet()
+        merged.merge(nodeset)
+        tdb.update_and_reference_root(root, EMPTY_ROOT, merged)
+        tdb.commit(root)
+
+        # warm the clean cache through reads
+        t2 = tdb.open_trie(root)
+        for _ in range(50):
+            t2.get(rng.randbytes(32))
+        path = str(tmp_path / "clean.journal")
+        saved = tdb.save_clean_cache(path)
+        assert saved > 0
+
+        # fresh database over the same disk: journal restores the cache
+        tdb2 = TrieDatabase(diskdb)
+        assert tdb2.load_clean_cache(path) == saved
+        assert tdb2._cleans == tdb._cleans
+
+        # corrupt one entry: verify-or-skip drops it, rest loads
+        blob = bytearray(open(path, "rb").read())
+        blob[45] ^= 0xFF  # inside the first node body (after 5+32+4 header)
+        open(path, "wb").write(bytes(blob))
+        tdb3 = TrieDatabase(diskdb)
+        assert tdb3.load_clean_cache(path) == saved - 1
+
+    def test_missing_and_garbage_journal(self, tmp_path):
+        from coreth_tpu.ethdb import MemoryDB
+        from coreth_tpu.trie.triedb import TrieDatabase
+
+        tdb = TrieDatabase(MemoryDB())
+        assert tdb.load_clean_cache(str(tmp_path / "absent")) == 0
+        p = tmp_path / "junk"
+        p.write_bytes(b"not a journal")
+        assert tdb.load_clean_cache(str(p)) == 0
+
+    def test_double_load_does_not_double_count(self, tmp_path):
+        import random
+
+        from coreth_tpu.ethdb import MemoryDB
+        from coreth_tpu.trie.node import EMPTY_ROOT
+        from coreth_tpu.trie.triedb import TrieDatabase
+        from coreth_tpu.trie.trienode import MergedNodeSet
+
+        diskdb = MemoryDB()
+        tdb = TrieDatabase(diskdb)
+        t = tdb.open_trie()
+        rng = random.Random(5)
+        for _ in range(50):
+            t.update(rng.randbytes(32), rng.randbytes(60))
+        root, ns = t.commit()
+        merged = MergedNodeSet()
+        merged.merge(ns)
+        tdb.update_and_reference_root(root, EMPTY_ROOT, merged)
+        tdb.commit(root)
+        t2 = tdb.open_trie(root)
+        for _ in range(20):
+            t2.get(rng.randbytes(32))
+        path = str(tmp_path / "c.journal")
+        tdb.save_clean_cache(path)
+
+        tdb2 = TrieDatabase(diskdb)
+        n1 = tdb2.load_clean_cache(path)
+        size1 = tdb2._clean_size
+        assert tdb2.load_clean_cache(path) == 0  # all duplicates
+        assert tdb2._clean_size == size1
