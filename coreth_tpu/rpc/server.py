@@ -179,6 +179,49 @@ class RPCServer:
         thread.start()
         return self._httpd.server_address[1]
 
+    # --- IPC transport ----------------------------------------------------
+
+    def serve_ipc(self, path: str):
+        """Unix-domain-socket endpoint (rpc/ipc.go): newline-delimited
+        JSON-RPC, one connection per client, served on daemon threads.
+        Returns a stop() callable."""
+        import os
+        import socket
+        import socketserver
+
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        server = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    resp = server.handle_raw(line)
+                    self.wfile.write(resp + b"\n")
+                    self.wfile.flush()
+
+        class _Srv(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+
+        srv = _Srv(path, Handler)
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+
+        def stop():
+            srv.shutdown()
+            srv.server_close()
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+        return stop
+
     def stop(self) -> None:
         if self._httpd is not None:
             self._httpd.shutdown()

@@ -117,6 +117,52 @@ class _StackSampler:
                 f.write(f"{n}\t{fn}:{line}\t{name}\n")
 
 
+class ContinuousProfiler:
+    """startContinuousProfiler (vm.go:1642 + config.go:89-91): rolls a
+    CPU stack-sample profile to disk every [freq] seconds, keeping
+    [max_files] generations (cpu.profile.1 newest)."""
+
+    def __init__(self, profile_dir: str, freq: float = 900.0,
+                 max_files: int = 5):
+        import threading
+
+        self.dir = profile_dir
+        self.freq = freq
+        self.max_files = max_files
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._sampler = None
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _roll(self):
+        import os
+
+        os.makedirs(self.dir, exist_ok=True)
+        for i in range(self.max_files - 1, 0, -1):
+            src = os.path.join(self.dir, f"cpu.profile.{i}")
+            if os.path.exists(src):
+                os.replace(src, os.path.join(self.dir, f"cpu.profile.{i + 1}"))
+        if self._sampler is not None:
+            self._sampler.stop()
+            self._sampler.dump(os.path.join(self.dir, "cpu.profile.1"))
+        self._sampler = _StackSampler(interval=0.01)
+        self._sampler.start()
+
+    def _run(self):
+        self._roll()
+        while not self._stop.wait(self.freq):
+            self._roll()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2)
+        if self._sampler is not None:
+            self._sampler.stop()
+
+
 class AdminAPI:
     """coreth-admin (admin.go:29-62). Profiles are real artifacts written
     to [profile_dir] (admin.go performanceProfile dir): CPU via an

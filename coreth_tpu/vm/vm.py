@@ -184,7 +184,23 @@ class VM:
         self.initialized = True
 
         # notify the engine when txs arrive (block_builder.go signal)
+        # build throttling (block_builder.go:55-129): one PendingTxs
+        # notification per outstanding build, retry-timer recovery
+        from .block_builder import BlockBuilder
+
+        self.block_builder = BlockBuilder(self)
         self.txpool.subscribe_new_txs(lambda txs: self._signal_txs_ready())
+
+        # continuous profiler (vm.go:1642, config.go:89-91)
+        self.continuous_profiler = None
+        if self.full_config.continuous_profiler_dir:
+            from .api import ContinuousProfiler
+
+            self.continuous_profiler = ContinuousProfiler(
+                self.full_config.continuous_profiler_dir,
+                freq=self.full_config.continuous_profiler_frequency,
+                max_files=self.full_config.continuous_profiler_max_files,
+            ).start()
 
     @staticmethod
     def _now() -> int:
@@ -197,8 +213,7 @@ class VM:
         return self.chain_config.rules(head.number + 1, head.time)
 
     def _signal_txs_ready(self) -> None:
-        if self.to_engine is not None:
-            self.to_engine()
+        self.block_builder.signal_txs_ready()
 
     # --- consensus callbacks (vm.go:696-851) ------------------------------
 
@@ -274,6 +289,15 @@ class VM:
 
     def build_block(self) -> VMBlock:
         """buildBlock (vm.go:991-1032)."""
+        try:
+            return self._build_block_inner()
+        finally:
+            # the engine consumed the PendingTxs notification by calling
+            # us — success or not, reopen the gate + arm the retry timer
+            # (block_builder.go handleGenerateBlock)
+            self.block_builder.handle_generate_block()
+
+    def _build_block_inner(self) -> VMBlock:
         with self.lock:
             self._building_txs = []
             try:
@@ -327,6 +351,9 @@ class VM:
 
     def shutdown(self) -> None:
         if self.initialized:
+            self.block_builder.shutdown()
+            if self.continuous_profiler is not None:
+                self.continuous_profiler.stop()
             self.blockchain.stop()
 
     # --- VMBlock support ---------------------------------------------------
