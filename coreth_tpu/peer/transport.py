@@ -1,0 +1,193 @@
+"""TCP transport for peer.Network (the production counterpart of the
+in-process wiring; role of the AvalancheGo AppRequest plumbing the
+reference rides, peer/network.go over p2p).
+
+Framing: length-prefixed messages with request-id correlation so one
+persistent connection multiplexes concurrent requests:
+
+    u32 BE total_len | u8 kind | u64 BE request_id | payload
+    kind: 0 = request, 1 = response, 2 = gossip (request_id ignored)
+
+`TransportServer` accepts connections and answers through the local
+Network's inbound handler. `dial()` returns a callable matching the
+Network transport contract `(sender_id, request) -> response`, so remote
+peers plug into `Network.connect` exactly like in-process ones."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, Dict, Optional
+
+KIND_REQUEST = 0
+KIND_RESPONSE = 1
+KIND_GOSSIP = 2
+
+_MAX_FRAME = 32 * 1024 * 1024
+
+
+class TransportError(Exception):
+    pass
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise TransportError("connection closed")
+        buf += chunk
+    return buf
+
+
+def _read_frame(sock):
+    total = struct.unpack(">I", _read_exact(sock, 4))[0]
+    if total > _MAX_FRAME or total < 9:
+        raise TransportError(f"bad frame length {total}")
+    body = _read_exact(sock, total)
+    kind = body[0]
+    req_id = struct.unpack(">Q", body[1:9])[0]
+    return kind, req_id, body[9:]
+
+
+def _write_frame(sock, lock, kind: int, req_id: int, payload: bytes):
+    frame = struct.pack(">IBQ", 9 + len(payload), kind, req_id) + payload
+    with lock:
+        sock.sendall(frame)
+
+
+class TransportServer:
+    """Listens for peers; inbound requests go to handler(sender, bytes)
+    -> bytes; inbound gossip goes to gossip_handler(sender, bytes)."""
+
+    def __init__(self, handler: Callable[[bytes, bytes], bytes],
+                 gossip_handler: Optional[Callable[[bytes, bytes], None]] = None):
+        self.handler = handler
+        self.gossip_handler = gossip_handler
+        self._sock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(32)
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        return self._sock.getsockname()[1]
+
+    def stop(self):
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn, addr),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn, addr):
+        sender = f"{addr[0]}:{addr[1]}".encode()
+        wlock = threading.Lock()
+        try:
+            while not self._stop.is_set():
+                kind, req_id, payload = _read_frame(conn)
+                if kind == KIND_GOSSIP:
+                    if self.gossip_handler is not None:
+                        try:
+                            self.gossip_handler(sender, payload)
+                        except Exception:
+                            pass
+                    continue
+                if kind != KIND_REQUEST:
+                    continue
+
+                def work(rid=req_id, data=payload):
+                    try:
+                        resp = self.handler(sender, data)
+                    except Exception:
+                        resp = b""
+                    try:
+                        _write_frame(conn, wlock, KIND_RESPONSE, rid, resp)
+                    except OSError:
+                        pass
+
+                # answer concurrently: one slow request must not head-of-
+                # line-block the connection (AppRequest concurrency)
+                threading.Thread(target=work, daemon=True).start()
+        except (TransportError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class RemotePeer:
+    """Client side of one connection; usable as a Network transport."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self._wlock = threading.Lock()
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+        self._waiters: Dict[int, "threading.Event"] = {}
+        self._responses: Dict[int, bytes] = {}
+        self._dead: Optional[Exception] = None
+        threading.Thread(target=self._read_loop, daemon=True).start()
+
+    def _read_loop(self):
+        try:
+            while True:
+                kind, req_id, payload = _read_frame(self.sock)
+                if kind != KIND_RESPONSE:
+                    continue
+                ev = self._waiters.get(req_id)
+                if ev is not None:
+                    self._responses[req_id] = payload
+                    ev.set()
+        except (TransportError, OSError) as e:
+            self._dead = e
+            for ev in list(self._waiters.values()):
+                ev.set()
+
+    def __call__(self, sender_id: bytes, request: bytes) -> bytes:
+        """Network transport contract: blocking request/response."""
+        if self._dead is not None:
+            raise TransportError(f"peer connection dead: {self._dead}")
+        with self._id_lock:
+            self._next_id += 1
+            rid = self._next_id
+        ev = threading.Event()
+        self._waiters[rid] = ev
+        try:
+            _write_frame(self.sock, self._wlock, KIND_REQUEST, rid, request)
+            if not ev.wait(timeout=self.sock.gettimeout()):
+                raise TransportError("request timed out")
+            if self._dead is not None and rid not in self._responses:
+                raise TransportError(f"peer connection dead: {self._dead}")
+            return self._responses.pop(rid)
+        finally:
+            self._waiters.pop(rid, None)
+            self._responses.pop(rid, None)
+
+    def gossip(self, payload: bytes) -> None:
+        _write_frame(self.sock, self._wlock, KIND_GOSSIP, 0, payload)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def dial(host: str, port: int, timeout: float = 30.0) -> RemotePeer:
+    return RemotePeer(host, port, timeout)
