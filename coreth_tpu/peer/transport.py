@@ -169,7 +169,10 @@ class RemotePeer:
         ev = threading.Event()
         self._waiters[rid] = ev
         try:
-            _write_frame(self.sock, self._wlock, KIND_REQUEST, rid, request)
+            try:
+                _write_frame(self.sock, self._wlock, KIND_REQUEST, rid, request)
+            except OSError as e:  # socket died between checks
+                raise TransportError(f"peer connection dead: {e}") from e
             if not ev.wait(timeout=self.sock.gettimeout()):
                 raise TransportError("request timed out")
             if self._dead is not None and rid not in self._responses:
