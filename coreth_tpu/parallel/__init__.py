@@ -110,3 +110,33 @@ def commit_step(mesh: Mesh, axis: str = "batch"):
         return step(w, nb)
 
     return run
+
+
+def sharded_seg_impl(mesh: Mesh, axis: str = "batch"):
+    """Per-segment keccak for ops.keccak_planned.PlannedCommit with the
+    lane dimension sharded across [mesh] (SURVEY §2.7: the 16-goroutine
+    hasher fan-out re-landed as data parallelism over ICI).
+
+    Composition: the planned executor's surrounding ops (patch gathers,
+    scatter-add, digest updates) stay replicated — only the keccak FLOPs
+    shard. GSPMD inserts the all-gather of digests back to replicated;
+    lanes are always a multiple of 16 (planner bucketing), so every mesh
+    size up to 16 divides evenly."""
+    from ..ops.keccak_staged import _segment_keccak
+
+    lane_sharded = NamedSharding(mesh, P(axis, None, None))
+    replicated = NamedSharding(mesh, P())
+
+    def impl(words):
+        w = jax.lax.with_sharding_constraint(words, lane_sharded)
+        out = _segment_keccak(w)
+        return jax.lax.with_sharding_constraint(out, replicated)
+
+    return impl
+
+
+def planned_commit_over_mesh(mesh: Mesh, axis: str = "batch"):
+    """A PlannedCommit whose hashing shards across [mesh]."""
+    from ..ops.keccak_planned import PlannedCommit
+
+    return PlannedCommit(seg_impl=sharded_seg_impl(mesh, axis))

@@ -63,3 +63,26 @@ class TestCommitStep:
         assert digests == [ref_keccak(m) for m in msgs]
         # the psum-style reduction over the sharded digest tensor matches host
         assert int(np.asarray(checksum)) == int(np.sum(out, dtype=np.uint32))
+
+
+def test_planned_commit_sharded_over_mesh():
+    """The full planned commit (patch chains included) with its keccak
+    sharded across the 8-device mesh must reproduce the host oracle's
+    root bit-exactly."""
+    import random
+
+    from coreth_tpu.native.mpt import load, plan_from_items
+    from coreth_tpu.parallel import make_mesh, planned_commit_over_mesh
+
+    if load() is None:
+        import pytest
+
+        pytest.skip("native planner unavailable")
+    rng = random.Random(31)
+    items = [(rng.randbytes(32), rng.randbytes(rng.randint(40, 90)))
+             for _ in range(900)]
+    plan = plan_from_items(items)
+    mesh = make_mesh(8)
+    runner = planned_commit_over_mesh(mesh)
+    root = plan.execute_planned(runner)
+    assert root == plan.execute_cpu()
