@@ -135,8 +135,18 @@ def sharded_seg_impl(mesh: Mesh, axis: str = "batch"):
     return impl
 
 
-def planned_commit_over_mesh(mesh: Mesh, axis: str = "batch"):
-    """A PlannedCommit whose hashing shards across [mesh]."""
-    from ..ops.keccak_planned import PlannedCommit
+_planned_by_mesh: dict = {}
 
-    return PlannedCommit(seg_impl=sharded_seg_impl(mesh, axis))
+
+def planned_commit_over_mesh(mesh: Mesh, axis: str = "batch"):
+    """A PlannedCommit whose hashing shards across [mesh]. Cached per
+    (mesh, axis) so repeated commits reuse one jit trace cache instead of
+    re-tracing every segment shape per call."""
+    key = (tuple(d.id for d in mesh.devices.flat), axis)
+    runner = _planned_by_mesh.get(key)
+    if runner is None:
+        from ..ops.keccak_planned import PlannedCommit
+
+        runner = PlannedCommit(seg_impl=sharded_seg_impl(mesh, axis))
+        _planned_by_mesh[key] = runner
+    return runner

@@ -1,0 +1,131 @@
+"""State-test fixture harness (role of /root/reference/tests/
+state_test_util.go + tests/init.go's fork-config table).
+
+Fixtures use the Ethereum GeneralStateTests shape (env/pre/transaction/
+post-per-fork); the runner rebuilds the pre-state, applies the
+transaction under each fork's rules, commits, and compares the state
+root and the keccak of the RLP-encoded logs. Golden roots are frozen in
+tests/fixtures/*.json — any consensus-visible change to the EVM, state
+transition, trie, or fork lattice trips them."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+from coreth_tpu import params, rlp
+from coreth_tpu.core.state_transition import (GasPool, apply_message,
+                                              tx_as_message)
+from coreth_tpu.core.types import Signer, Transaction
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.evm.evm import EVM, BlockContext, Config, TxContext
+from coreth_tpu.native import keccak256
+from coreth_tpu.state.database import Database
+from coreth_tpu.state.statedb import StateDB
+from coreth_tpu.trie.node import EMPTY_ROOT
+from coreth_tpu.trie.triedb import TrieDatabase
+
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "fixtures")
+
+# tests/init.go Forks table analog: named fork schedules
+FORKS: Dict[str, params.ChainConfig] = {
+    "Istanbul": params.ChainConfig(chain_id=43112),  # eth forks only
+    "ApricotPhase2": params.ChainConfig(
+        chain_id=43112, apricot_phase1_time=0, apricot_phase2_time=0),
+    "ApricotPhase5": params.ChainConfig(
+        chain_id=43112, apricot_phase1_time=0, apricot_phase2_time=0,
+        apricot_phase3_time=0, apricot_phase4_time=0, apricot_phase5_time=0),
+    "Cortina": params.TEST_CHAIN_CONFIG,
+}
+
+
+def _b(s: str) -> bytes:
+    return bytes.fromhex(s[2:] if s.startswith("0x") else s)
+
+
+def _i(v) -> int:
+    if isinstance(v, int):
+        return v
+    return int(v, 16) if isinstance(v, str) and v.startswith("0x") else int(v)
+
+
+def build_pre_state(pre: dict, db: Database) -> StateDB:
+    st = StateDB(EMPTY_ROOT, db)
+    for addr_hex, acct in pre.items():
+        addr = _b(addr_hex)
+        st.add_balance(addr, _i(acct.get("balance", 0)))
+        st.set_nonce(addr, _i(acct.get("nonce", 0)))
+        if acct.get("code"):
+            st.set_code(addr, _b(acct["code"]))
+        for k, v in acct.get("storage", {}).items():
+            st.set_state(addr, _b(k).rjust(32, b"\x00"),
+                         _b(v).rjust(32, b"\x00"))
+    return st
+
+
+def logs_hash(logs) -> bytes:
+    """keccak(rlp(logs)) — state_test_util.go rlpHash(receipt logs)."""
+    items = [[l.address, list(l.topics), l.data] for l in logs]
+    return keccak256(rlp.encode(items))
+
+
+def run_case(case: dict, fork: str) -> dict:
+    """Execute one fixture under [fork]; returns {"root","logs"} hex."""
+    cfg = FORKS[fork]
+    db = Database(TrieDatabase(MemoryDB()))
+    st = build_pre_state(case["pre"], db)
+    st.commit()  # pre-state root settles like a genesis commit
+
+    env = case["env"]
+    txd = case["transaction"]
+    tx = Transaction(
+        type=_i(txd.get("type", 0)),
+        chain_id=cfg.chain_id if _i(txd.get("type", 0)) else 0,
+        nonce=_i(txd.get("nonce", 0)),
+        gas=_i(txd["gasLimit"]),
+        gas_price=_i(txd.get("gasPrice", 0)),
+        max_fee=_i(txd.get("maxFeePerGas", txd.get("gasPrice", 0))),
+        max_priority_fee=_i(txd.get("maxPriorityFeePerGas", 0)),
+        to=_b(txd["to"]) if txd.get("to") else None,
+        value=_i(txd.get("value", 0)),
+        data=_b(txd.get("data", "0x")),
+    )
+    signer = Signer(cfg.chain_id)
+    tx = signer.sign(tx, _b(txd["secretKey"]))
+
+    number = _i(env.get("currentNumber", 1))
+    ts = _i(env.get("currentTimestamp", 1))
+    base_fee = (_i(env["currentBaseFee"])
+                if "currentBaseFee" in env
+                and cfg.is_apricot_phase3(ts) else None)
+    bctx = BlockContext(
+        block_number=number, time=ts,
+        gas_limit=_i(env.get("currentGasLimit", 10_000_000)),
+        coinbase=_b(env.get("currentCoinbase", "0x" + "00" * 20)),
+        base_fee=base_fee,
+    )
+    evm = EVM(bctx, TxContext(origin=signer.sender(tx),
+                              gas_price=tx.effective_gas_price(base_fee)),
+              st, cfg, Config())
+    gp = GasPool(bctx.gas_limit)
+    st.set_tx_context(tx.hash(), 0)
+    logs = []
+    try:
+        msg = tx_as_message(tx, signer, base_fee)
+        apply_message(evm, msg, gp)
+        logs = st.get_logs(tx.hash(), number, b"\x00" * 32)
+    except Exception:
+        pass  # invalid txs leave only the pre-state (+ any partial fees)
+    root = st.commit(cfg.is_eip158(number))
+    return {"root": "0x" + root.hex(), "logs": "0x" + logs_hash(logs).hex()}
+
+
+def run_fixture_file(path: str):
+    """Yield (test_name, fork, expected, got) for every post entry."""
+    with open(path) as f:
+        suite = json.load(f)
+    for name, case in suite.items():
+        for fork, expect in case["post"].items():
+            got = run_case(case, fork)
+            yield name, fork, expect, got

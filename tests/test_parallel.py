@@ -75,8 +75,6 @@ def test_planned_commit_sharded_over_mesh():
     from coreth_tpu.parallel import make_mesh, planned_commit_over_mesh
 
     if load() is None:
-        import pytest
-
         pytest.skip("native planner unavailable")
     rng = random.Random(31)
     items = [(rng.randbytes(32), rng.randbytes(rng.randint(40, 90)))
