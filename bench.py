@@ -40,12 +40,41 @@ def build_workload(n_leaves: int, seed: int = 1):
     return items_to_arrays(items)
 
 
+def _arm_watchdog(seconds: float):
+    """The axon tunnel has been observed to wedge so hard that ANY device
+    op hangs forever. Rather than timing out silently, report a
+    diagnostic JSON line and exit: the driver then records a parseable
+    failure instead of nothing."""
+    import threading
+
+    def fire():
+        print(
+            json.dumps({
+                "metric": "trie_commit_nodes_per_sec",
+                "value": 0.0,
+                "unit": "nodes/s",
+                "vs_baseline": 0.0,
+                "error": f"device wedged: no progress within {seconds:.0f}s "
+                         "(see PERF.md caveat; tunnel hang, not a compute result)",
+            }),
+            flush=True,
+        )
+        os._exit(3)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main():
     n_leaves = int(os.environ.get("CORETH_TPU_BENCH_LEAVES", "200000"))
     repeats = int(os.environ.get("CORETH_TPU_BENCH_REPEATS", "3"))
     cpu_threads = int(os.environ.get("CORETH_TPU_BENCH_CPU_THREADS", "0")) or (
         os.cpu_count() or 1
     )
+    watchdog = _arm_watchdog(
+        float(os.environ.get("CORETH_TPU_BENCH_WATCHDOG", "480")))
 
     from coreth_tpu.utils import enable_compilation_cache
 
@@ -98,6 +127,7 @@ def main():
         )
         sys.exit(1)
 
+    watchdog.cancel()
     tpu_rate = nodes / tpu_s
     cpu_rate = nodes / cpu_s
     print(
