@@ -27,11 +27,17 @@ def get_batch_keccak(mode: str = "auto") -> Optional[Callable]:
           "batched" — same callable, but unavailability is an error: the
                       operator forced the device path, so degrading quietly
                       would hide a node-wide throughput regression
+          "fused"   — single-dispatch commits: Trie.hash ships the whole
+                      dirty set in ONE transfer with on-device digest
+                      patching (trie/hasher.FusedHasher) instead of one
+                      dispatch per level — the right mode when the
+                      host<->device link charges per round trip; fails
+                      loudly like "batched"
           "off"     — None (CPU recursive hasher everywhere)
     """
     if mode == "off":
         return None
-    if mode not in ("auto", "batched"):
+    if mode not in ("auto", "batched", "fused"):
         raise ValueError(f"unknown device-hasher mode {mode!r}")
     if "fn" not in _cached:
         try:
@@ -47,9 +53,26 @@ def get_batch_keccak(mode: str = "auto") -> Optional[Callable]:
             warnings.warn(f"device keccak unavailable, chain runs CPU-only: {e!r}")
             _cached["fn"] = None
             _cached["error"] = e
-    if _cached["fn"] is None and mode == "batched":
+    if _cached["fn"] is None and mode in ("batched", "fused"):
         raise RuntimeError(
-            "device-hasher forced to 'batched' but the device keccak failed "
+            f"device-hasher forced to {mode!r} but the device keccak failed "
             f"to resolve: {_cached.get('error')!r}"
         )
+    if mode == "fused" and _cached["fn"] is not None:
+        return FusedModeKeccak(_cached["fn"])
     return _cached["fn"]
+
+
+class FusedModeKeccak:
+    """Marker wrapper telling Trie.hash to take the single-dispatch
+    FusedHasher path; still callable as a plain batch keccak so every
+    other consumer of the seam (proof verification, precompile) works
+    unchanged."""
+
+    fused = True
+
+    def __init__(self, digests):
+        self._digests = digests
+
+    def __call__(self, msgs):
+        return self._digests(msgs)

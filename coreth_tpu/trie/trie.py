@@ -233,7 +233,14 @@ class Trie:
             self._batch_keccak is not None
             and self.unhashed >= BATCH_THRESHOLD
         ):
-            h = BatchedHasher(self._batch_keccak).hash_root(self.root)
+            if getattr(self._batch_keccak, "fused", False):
+                # single-dispatch commit: one transfer for the whole
+                # dirty set, digests patched on-device between levels
+                from .hasher import FusedHasher
+
+                h = FusedHasher().hash_root(self.root)
+            else:
+                h = BatchedHasher(self._batch_keccak).hash_root(self.root)
         else:
             h, _ = Hasher().hash(self.root, True)
         self.unhashed = 0

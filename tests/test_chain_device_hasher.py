@@ -111,6 +111,34 @@ def test_chain_insert_accept_device_hasher_shadow():
     assert device_chain.current_block.root == shadow_chain.current_block.root
 
 
+def test_fused_mode_chain_parity():
+    """device_hasher="fused": Trie.hash takes the single-dispatch
+    FusedHasher path; roots must still match the CPU shadow chain."""
+    from coreth_tpu.ops.device import FusedModeKeccak
+    from coreth_tpu.ops.keccak_jax import BatchedKeccak
+
+    fused_chain = make_chain(FusedModeKeccak(BatchedKeccak().digests))
+    shadow_chain = make_chain(None)
+    base_fee = params.APRICOT_PHASE3_INITIAL_BASE_FEE
+
+    def gen(i, bg):
+        bf = bg.base_fee() or base_fee
+        for j, key in enumerate(KEYS):
+            to = (0x9000 + i * N_SENDERS + j).to_bytes(20, "big")
+            bg.add_tx(transfer_tx(i, to, key, bf))
+
+    blocks, _ = generate_chain(
+        fused_chain.config, fused_chain.current_block, fused_chain.engine,
+        fused_chain.state_database, 1, gen=gen,
+    )
+    for chain in (fused_chain, shadow_chain):
+        for b in blocks:
+            chain.insert_block(b)
+            chain.accept(b)
+        chain.drain_acceptor_queue()
+    assert fused_chain.current_block.root == shadow_chain.current_block.root
+
+
 def test_vm_config_device_hasher_knob():
     """The JSON knob parses and validates (config.go-style)."""
     from coreth_tpu.vm.config import parse_config
@@ -119,5 +147,6 @@ def test_vm_config_device_hasher_knob():
     assert cfg.device_hasher == "off"
     cfg = parse_config(b"{}")
     assert cfg.device_hasher == "auto"
+    assert parse_config(b'{"device-hasher": "fused"}').device_hasher == "fused"
     with pytest.raises(ValueError):
         parse_config(b'{"device-hasher": "warp"}')
