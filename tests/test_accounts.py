@@ -274,3 +274,50 @@ class TestMetrics:
         chain.insert_block(blocks[0])
         assert default_registry.timer("chain/block/inserts").count() == before + 1
         chain.stop()
+
+
+class TestManager:
+    """accounts.Manager wallet registry + keystore dir watching
+    (manager.go + keystore watch.go)."""
+
+    def test_registry_and_events(self, tmp_path):
+        import time
+
+        from coreth_tpu.accounts.keystore import KeyStore
+        from coreth_tpu.accounts.manager import (
+            WALLET_ARRIVED,
+            WALLET_DROPPED,
+            Manager,
+        )
+
+        ks = KeyStore(str(tmp_path), light=True)
+        a1 = ks.new_account("pw")
+        mgr = Manager(ks, poll_interval=0.05)
+        assert [a.address for a in mgr.accounts()] == [a1.address]
+        assert mgr.find(a1.address) is not None
+
+        events = []
+        cancel = mgr.subscribe(events.append)
+        mgr.start_watching()
+        try:
+            a2 = ks.import_key(b"\x21" * 32, "pw")
+            deadline = time.time() + 5
+            while not events and time.time() < deadline:
+                time.sleep(0.02)
+            assert events and events[0].kind == WALLET_ARRIVED
+            assert events[0].account.address == a2.address
+            assert mgr.find(a2.address) is not None
+
+            events.clear()
+            ks.delete(a2.address, "pw")
+            deadline = time.time() + 5
+            while not events and time.time() < deadline:
+                time.sleep(0.02)
+            assert events and events[0].kind == WALLET_DROPPED
+            cancel()
+            events.clear()
+            ks.import_key(b"\x22" * 32, "pw")
+            mgr.refresh()
+            assert not events  # unsubscribed sinks stay silent
+        finally:
+            mgr.stop()
