@@ -49,6 +49,9 @@ class VMConfig:
     commit_interval: int = 4096
     mempool_size: int = 4096
     clock: Optional[object] = None
+    # flat snapshot tree (config.go snapshot-cache; 0 disables). The VM
+    # serves sync leaves from it when enabled (leafs_request fast path).
+    snapshot_limit: int = 256
     # "auto"/"batched": drain large dirty sets to the device keccak from
     # Trie.hash (trie/trie.go:618-619 parallel-threshold analog); "off": CPU
     device_hasher: str = "auto"
@@ -133,6 +136,7 @@ class VM:
                 pruning=self.config.pruning,
                 commit_interval=self.config.commit_interval,
                 device_hasher=self.config.device_hasher,
+                snapshot_limit=self.config.snapshot_limit,
             ),
             self.chain_config,
             genesis,
@@ -190,6 +194,15 @@ class VM:
 
         self.block_builder = BlockBuilder(self)
         self.txpool.subscribe_new_txs(lambda txs: self._signal_txs_ready())
+
+        # inbound sync server (vm.go:547 initializeStateSyncServer): leaf/
+        # block/code requests served off this chain, snapshot fast path
+        # engaged automatically when the chain runs one
+        from ..sync.handlers import SyncHandler
+
+        self.sync_handler = SyncHandler(
+            self.blockchain, self.state_database.triedb, diskdb
+        )
 
         # continuous profiler (vm.go:1642, config.go:89-91)
         self.continuous_profiler = None

@@ -498,3 +498,26 @@ class TestBlockBuilderThrottling:
         vm.issue_tx(signed_transfer(0))
         assert len(notes) == 1  # gate was reopened by the failed build
         vm.shutdown()
+
+
+class TestVMSyncServer:
+    def test_vm_serves_leaves_with_snapshot_fast_path(self):
+        """The production VM wires its own sync server over the chain's
+        snapshot (vm.go:547 initializeStateSyncServer)."""
+        from coreth_tpu.sync.messages import LeafsRequest, decode_message
+
+        vm, _ = genesis_vm()
+        assert vm.blockchain.snaps is not None  # snapshots on by default
+        vm.issue_tx(signed_transfer(0))
+        blk = vm.build_block(); blk.verify(); blk.accept()
+        vm.blockchain.drain_acceptor_queue()
+
+        root = vm.blockchain.last_accepted.root
+        req = LeafsRequest(root=root, limit=16)
+        # fast path must actually serve (not silently fall to the trie)
+        trie = vm.state_database.triedb.open_trie(root)
+        assert vm.sync_handler.leafs._try_snapshot(req, trie, 16, None) is not None
+        raw = vm.sync_handler.handle(b"peer", req.encode())
+        resp = decode_message(raw)
+        assert len(resp.keys) >= 2  # sender + dest (+coinbase)
+        vm.shutdown()
