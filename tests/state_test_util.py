@@ -90,6 +90,12 @@ def run_case(case: dict, fork: str) -> dict:
         to=_b(txd["to"]) if txd.get("to") else None,
         value=_i(txd.get("value", 0)),
         data=_b(txd.get("data", "0x")),
+        # AccessTuple is a plain (address, [storage keys]) pair
+        access_list=[
+            (_b(e["address"]),
+             [_b(k).rjust(32, b"\x00") for k in e.get("storageKeys", [])])
+            for e in txd.get("accessList", [])
+        ],
     )
     signer = Signer(cfg.chain_id)
     tx = signer.sign(tx, _b(txd["secretKey"]))
