@@ -246,9 +246,16 @@ def main():
     from coreth_tpu.utils import enable_compilation_cache
 
     enable_compilation_cache()
+    # the device-leg configs (1, 2, 5) hang forever if the tunnel wedges;
+    # reuse bench.py's watchdog so the driver gets a diagnostic line
+    from bench import _arm_watchdog
+
+    watchdog = _arm_watchdog(
+        float(os.environ.get("CORETH_TPU_BENCH_WATCHDOG", "540")))
     picks = [int(a) for a in sys.argv[1:]] or [1, 2, 3, 4, 5]
     for i in picks:
         globals()[f"bench_{i}"]()
+    watchdog.cancel()
 
 
 if __name__ == "__main__":
