@@ -321,3 +321,37 @@ class TestManager:
             assert not events  # unsubscribed sinks stay silent
         finally:
             mgr.stop()
+
+
+class TestPublicInterfaces:
+    def test_ethclient_satisfies_protocols(self):
+        """ethclient.Client must structurally satisfy every public
+        client interface (interfaces/interfaces.go contract)."""
+        from coreth_tpu import interfaces as I
+        from coreth_tpu.ethclient import Client
+
+        c = Client(server=None)
+        for proto in (I.ChainReader, I.ChainStateReader, I.TransactionSender,
+                      I.ContractCaller, I.GasEstimator, I.LogFilterer,
+                      I.TransactionReader):
+            assert isinstance(c, proto), proto.__name__
+
+    def test_bound_contract_uses_caller_protocol(self):
+        """bind.BoundContract only needs the protocol surface — a minimal
+        structural stub works as its client."""
+        from coreth_tpu.accounts.abi import ABI
+        from coreth_tpu.accounts.bind import BoundContract
+
+        calls = []
+
+        class Stub:
+            def call_contract(self, obj, block="latest"):
+                calls.append(obj)
+                return (7).to_bytes(32, "big")
+
+        abi = ABI([{"type": "function", "name": "f", "inputs": [],
+                    "outputs": [{"name": "", "type": "uint256"}],
+                    "stateMutability": "view"}])
+        bc = BoundContract(b"\x01" * 20, abi, Stub())
+        assert bc.call("f") == [7]
+        assert calls and calls[0]["to"] == "0x" + ("01" * 20)
