@@ -22,22 +22,28 @@ _cached: dict = {}
 def get_batch_keccak(mode: str = "auto") -> Optional[Callable]:
     """Resolve a `list[bytes] -> list[bytes32]` batched keccak, or None.
 
-    mode: "auto"    — device-batched hashing when the backend resolves,
-                      silent CPU fallback otherwise
-          "batched" — same callable, but unavailability is an error: the
-                      operator forced the device path, so degrading quietly
-                      would hide a node-wide throughput regression
+    mode: "auto"    — the planned u32 executor when the backend resolves
+                      (same as "planned"), silent CPU fallback otherwise
+          "planned" — the production fast path: Trie.hash/StateDB commits
+                      drain through trie/planned.PlannedGraphBuilder ->
+                      ops/keccak_planned.PlannedCommit — ONE bulk u32
+                      transfer per commit, child digests AND storage roots
+                      patched on device in word space, zero byte-level ops
+                      on device. Fails loudly when forced.
+          "batched" — level-batched hashing (one dispatch per trie level);
+                      unavailability is an error: the operator forced the
+                      device path, so degrading quietly would hide a
+                      node-wide throughput regression
           "fused"   — single-dispatch commits: Trie.hash ships the whole
                       dirty set in ONE transfer with on-device digest
-                      patching (trie/hasher.FusedHasher) instead of one
-                      dispatch per level — the right mode when the
-                      host<->device link charges per round trip; fails
-                      loudly like "batched"
+                      patching (trie/hasher.FusedHasher). Superseded by
+                      "planned" (its on-device uint8 unpacking costs ~100x
+                      the hashing, PERF.md); kept for A/B comparison.
           "off"     — None (CPU recursive hasher everywhere)
     """
     if mode == "off":
         return None
-    if mode not in ("auto", "batched", "fused"):
+    if mode not in ("auto", "planned", "batched", "fused"):
         raise ValueError(f"unknown device-hasher mode {mode!r}")
     if "fn" not in _cached:
         try:
@@ -53,14 +59,33 @@ def get_batch_keccak(mode: str = "auto") -> Optional[Callable]:
             warnings.warn(f"device keccak unavailable, chain runs CPU-only: {e!r}")
             _cached["fn"] = None
             _cached["error"] = e
-    if _cached["fn"] is None and mode in ("batched", "fused"):
+    if _cached["fn"] is None and mode in ("planned", "batched", "fused"):
         raise RuntimeError(
             f"device-hasher forced to {mode!r} but the device keccak failed "
             f"to resolve: {_cached.get('error')!r}"
         )
-    if mode == "fused" and _cached["fn"] is not None:
+    if _cached["fn"] is None:
+        return None
+    if mode == "fused":
         return FusedModeKeccak(_cached["fn"])
+    if mode in ("auto", "planned"):
+        return PlannedModeKeccak(_cached["fn"])
     return _cached["fn"]
+
+
+class PlannedModeKeccak:
+    """Marker wrapper telling Trie.hash / StateDB.intermediate_root to take
+    the planned u32 executor path; still callable as a plain batch keccak
+    so every other consumer of the seam (proof verification, precompile)
+    works unchanged."""
+
+    planned = True
+
+    def __init__(self, digests):
+        self._digests = digests
+
+    def __call__(self, msgs):
+        return self._digests(msgs)
 
 
 class FusedModeKeccak:

@@ -157,3 +157,15 @@ class PlannedCommit:
             return host[root_pos + 1].astype("<u4").tobytes(), host[1:]
         root = np.asarray(dig[root_pos + 1])
         return root.astype("<u4").tobytes(), None
+
+
+_default_commit: Optional[PlannedCommit] = None
+
+
+def default_planned_commit() -> PlannedCommit:
+    """Process-wide PlannedCommit singleton (jit caches live on the
+    instance's step; sharing it keeps one compiled program per shape)."""
+    global _default_commit
+    if _default_commit is None:
+        _default_commit = PlannedCommit()
+    return _default_commit

@@ -36,6 +36,34 @@ class Account:
             ]
         )
 
+    def encode_with_root_hole(self):
+        """RLP with a zeroed storage-root slot + the slot's byte offset.
+
+        The planned commit path (trie/planned.py) patches the storage
+        trie's root digest into this hole ON DEVICE, so the account trie
+        and every storage trie hash in one program (the statedb.go:
+        1040-1160 ordering without host round-trips)."""
+        enc = rlp.encode(
+            [
+                self.nonce,
+                self.balance,
+                b"\x00" * 32,
+                self.code_hash,
+                1 if self.is_multi_coin else 0,
+            ]
+        )
+        # offset of the 32 root bytes: list header + nonce + balance + 0xa0
+        payload = (
+            len(rlp.encode(self.nonce)) + len(rlp.encode(self.balance))
+            + 33 + len(rlp.encode(self.code_hash)) + 1
+        )
+        hdr = 1 if payload < 56 else 1 + (payload.bit_length() + 7) // 8
+        off = (
+            hdr + len(rlp.encode(self.nonce)) + len(rlp.encode(self.balance)) + 1
+        )
+        assert enc[off:off + 32] == b"\x00" * 32
+        return enc, off
+
     @classmethod
     def decode(cls, blob: bytes) -> "Account":
         items = rlp.decode(blob)

@@ -376,10 +376,25 @@ class StateDB:
 
         Storage-root updates and account-trie writes happen here; the hash
         itself drains through the TPU batch seam when the dirty set is big.
+        In planned device mode every dirty storage trie AND the account
+        trie hash in ONE device program, with each storage root patched
+        into its account leaf's RLP on device (trie/planned.py; reference
+        ordering statedb.go:1040-1160).
         """
         from ..metrics import expensive_timer
 
         self.finalise(delete_empty)
+        marker = getattr(self.db.triedb, "batch_keccak", None)
+        if getattr(marker, "planned", False):
+            est = len(self._objects_pending) + sum(
+                len(self._objects[a].pending_storage)
+                for a in self._objects_pending
+                if not self._objects[a].deleted
+            )
+            from ..trie.hasher import BATCH_THRESHOLD
+
+            if est >= BATCH_THRESHOLD:
+                return self._planned_intermediate_root()
         with expensive_timer("state/account/updates"):
             for addr in sorted(self._objects_pending):
                 obj = self._objects[addr]
@@ -393,6 +408,108 @@ class StateDB:
         self._objects_pending = set()
         with expensive_timer("state/account/hashes"):
             return self.trie.hash()
+
+    def _planned_intermediate_root(self) -> bytes:
+        """One planned device program for the whole block commit.
+
+        Storage tries' dirty subtrees and the account trie's dirty subtree
+        lay out into a single u32 word stream; account leaves whose
+        storage root is still being computed carry a zeroed hole plus an
+        on-device patch from the storage trie's root lane. The host sees
+        ONE upload and one digest readback — the reference's sequential
+        storage->account ordering (statedb.go:1040-1160) collapses into a
+        single device dependency chain.
+        """
+        from ..metrics import expensive_timer
+        from ..trie.encoding import key_to_hex
+        from ..trie.node import FullNode, ShortNode
+        from ..trie.planned import PlannedGraphBuilder, TooManySegments
+
+        builder = PlannedGraphBuilder()
+        holes = {}
+        patched = []  # (addr, obj, handle, storage_trie)
+        plain = []    # (addr, obj) — snap accounting after real roots known
+        with expensive_timer("state/account/updates"):
+            for addr in sorted(self._objects_pending):
+                obj = self._objects[addr]
+                if obj.deleted:
+                    self.trie.delete(addr)
+                    continue
+                tr = obj.update_trie()
+                inner = tr.trie if tr is not None else None
+                if (
+                    inner is not None
+                    and isinstance(inner.root, (ShortNode, FullNode))
+                    and inner.root.flags.hash is None
+                ):
+                    handle = builder.add_trie(inner.root)
+                    enc, off = obj.data.encode_with_root_hole()
+                    self.trie.update(addr, enc)
+                    holes[key_to_hex(obj.addr_hash)] = (off, handle)
+                    patched.append((addr, obj, handle, tr))
+                else:
+                    if tr is not None:
+                        obj.data.root = tr.hash()
+                    self.trie.update(addr, obj.data.encode())
+                    plain.append((addr, obj))
+        self._objects_pending = set()
+
+        with expensive_timer("state/account/hashes"):
+            inner_acct = self.trie.trie
+            root_hash = None
+            if isinstance(inner_acct.root, (ShortNode, FullNode)) and (
+                inner_acct.root.flags.hash is None
+            ):
+                builder.add_account_trie(inner_acct.root, holes)
+                try:
+                    root_hash = builder.run()
+                except TooManySegments:
+                    root_hash = None
+                except BaseException:
+                    # a device failure mid-run must NOT leave the account
+                    # trie holding zeroed storage-root holes: heal them on
+                    # host before surfacing the error, so a retried/aborted
+                    # block never commits a silently-wrong root. The heal
+                    # must NOT touch the device again (tr.hash() would
+                    # route straight back to the broken planned path), so
+                    # it forces the recursive CPU hasher.
+                    self._heal_root_holes(patched, force_cpu=True)
+                    raise
+                if root_hash is not None:
+                    inner_acct.unhashed = 0
+                    for _addr, obj, handle, tr in patched:
+                        obj.data.root = builder.digest(handle)
+                        tr.trie.unhashed = 0
+            if root_hash is None:
+                # pathological segment shape (or nothing dirty): heal the
+                # holes on host and drain through the level hashers
+                self._heal_root_holes(patched, force_cpu=False)
+                root_hash = self.trie.hash()
+            if self.snap is not None:
+                for _addr, obj in plain:
+                    self._snap_accounts[obj.addr_hash] = _account_to_slim(obj.data)
+                for _addr, obj, _handle, _tr in patched:
+                    self._snap_accounts[obj.addr_hash] = _account_to_slim(obj.data)
+            return root_hash
+
+    def _heal_root_holes(self, patched, force_cpu: bool) -> None:
+        """Replace zeroed storage-root holes in account leaves with real
+        roots computed on host. force_cpu bypasses every device seam —
+        required when the device itself is the thing that just failed."""
+        from ..trie.hasher import Hasher
+        from ..trie.node import FullNode, ShortNode
+
+        for addr, obj, _handle, tr in patched:
+            inner = tr.trie
+            if force_cpu and isinstance(inner.root, (ShortNode, FullNode)) and (
+                inner.root.flags.hash is None
+            ):
+                h, _ = Hasher().hash(inner.root, True)
+                inner.unhashed = 0
+                obj.data.root = bytes(h)
+            else:
+                obj.data.root = tr.hash()
+            self.trie.update(addr, obj.data.encode())
 
     def commit(self, delete_empty: bool = False,
                block_hash: Optional[bytes] = None,

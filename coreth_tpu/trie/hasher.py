@@ -167,26 +167,37 @@ class BatchedHasher:
     @staticmethod
     def _collect_levels(root):
         """Group dirty (unhashed) Short/Full nodes by height, leaves first."""
-        levels: List[list] = []
+        return [
+            [n for n, _path in lvl] for lvl in collect_levels_with_paths(root)
+        ]
 
-        def visit(n) -> int:
-            # returns height of n within the dirty subtree; -1 for non-nodes
-            if not isinstance(n, (ShortNode, FullNode)) or n.flags.hash is not None:
-                return -1
+
+def collect_levels_with_paths(root):
+    """Group dirty (unhashed) Short/Full nodes by height with their full hex
+    paths, leaves first. Shared by the level-batched, fused, and planned
+    hashers so the height/dirtiness rules live in exactly one place."""
+    levels: List[list] = []
+
+    def visit(n, path: bytes) -> int:
+        # returns height of n within the dirty subtree; -1 for non-nodes
+        if not isinstance(n, (ShortNode, FullNode)) or n.flags.hash is not None:
+            return -1
+        if isinstance(n, ShortNode):
+            h = visit(n.val, path + n.key)
+        else:
             h = -1
-            if isinstance(n, ShortNode):
-                h = max(h, visit(n.val))
-            else:
-                for c in n.children[:16]:
-                    h = max(h, visit(c))
-            h += 1
-            while len(levels) <= h:
-                levels.append([])
-            levels[h].append(n)
-            return h
+            for i in range(16):
+                c = n.children[i]
+                if c is not None:
+                    h = max(h, visit(c, path + bytes([i])))
+        h += 1
+        while len(levels) <= h:
+            levels.append([])
+        levels[h].append((n, path))
+        return h
 
-        visit(root)
-        return levels
+    visit(root, b"")
+    return levels
 
 
 def new_hasher(dirty_estimate: int = 0, batch_keccak=None):

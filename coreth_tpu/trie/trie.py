@@ -233,7 +233,16 @@ class Trie:
             self._batch_keccak is not None
             and self.unhashed >= BATCH_THRESHOLD
         ):
-            if getattr(self._batch_keccak, "fused", False):
+            if getattr(self._batch_keccak, "planned", False):
+                # the u32 planned executor: one bulk word transfer,
+                # on-device digest patching, zero byte ops on device
+                from .planned import PlannedHasher, TooManySegments
+
+                try:
+                    h = PlannedHasher().hash_root(self.root)
+                except TooManySegments:
+                    h = BatchedHasher(self._batch_keccak).hash_root(self.root)
+            elif getattr(self._batch_keccak, "fused", False):
                 # single-dispatch commit: one transfer for the whole
                 # dirty set, digests patched on-device between levels
                 from .hasher import FusedHasher

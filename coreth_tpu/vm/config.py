@@ -118,7 +118,7 @@ class Config:
                 f"state sync commit interval ({self.state_sync_commit_interval}) "
                 f"must be a multiple of commit interval ({self.commit_interval})"
             )
-        if self.device_hasher not in ("auto", "batched", "fused", "off"):
+        if self.device_hasher not in ("auto", "planned", "batched", "fused", "off"):
             raise ValueError(f"unknown device-hasher mode {self.device_hasher!r}")
 
 

@@ -1,0 +1,175 @@
+"""Chain-level shadow run for device_hasher="planned": the production
+insert/accept path drains every block commit through the planned u32
+executor (trie/planned.PlannedGraphBuilder -> ops/keccak_planned), with
+dirty STORAGE tries and the account trie hashed in one device program and
+storage roots patched into account RLP on device.
+
+This is VERDICT round-2 item #1: the benched fast path IS the chain path.
+Reference seam: core/state/statedb.go:1040-1160 (storage->account commit
+ordering), trie/trie.go:618-619 (auto-engaged parallel hashing).
+"""
+
+import pytest
+
+from coreth_tpu import params
+from coreth_tpu.consensus.dummy import new_dummy_engine
+from coreth_tpu.core.blockchain import BlockChain, CacheConfig
+from coreth_tpu.core.chain_makers import generate_chain
+from coreth_tpu.core.genesis import Genesis, GenesisAccount
+from coreth_tpu.core.types import Signer, Transaction
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.state.database import Database
+from coreth_tpu.trie.triedb import TrieDatabase
+
+N_SENDERS = 60
+KEYS = [i.to_bytes(1, "big") * 32 for i in range(1, N_SENDERS + 1)]
+ADDRS = [priv_to_address(k) for k in KEYS]
+FUND = 10**21
+CHAIN_ID = 43112
+
+SLOTS_PER_CONTRACT = 6
+
+
+def storage_init_code(seed: int) -> bytes:
+    """Init code that SSTOREs SLOTS_PER_CONTRACT distinct slots and returns
+    empty runtime code — each deployment creates a dirty storage trie."""
+    code = bytearray()
+    for s in range(SLOTS_PER_CONTRACT):
+        v = (seed * 31 + s * 7 + 1) % 256 or 1
+        code += bytes([0x60, v, 0x60, s, 0x55])  # PUSH1 v PUSH1 s SSTORE
+    code += bytes([0x60, 0x00, 0x60, 0x00, 0xF3])  # RETURN(0, 0)
+    return bytes(code)
+
+
+class PlannedRunCounter:
+    """Counts planned-mode device programs actually executed."""
+
+    def __init__(self):
+        self.runs = 0
+
+    def install(self, monkeypatch):
+        from coreth_tpu.trie import planned
+
+        orig = planned.PlannedGraphBuilder.run
+        counter = self
+
+        def counted(selfb, *a, **kw):
+            counter.runs += 1
+            return orig(selfb, *a, **kw)
+
+        monkeypatch.setattr(planned.PlannedGraphBuilder, "run", counted)
+
+
+def make_chain(mode_marker):
+    cfg = params.TEST_CHAIN_CONFIG
+    diskdb = MemoryDB()
+    state_db = Database(TrieDatabase(diskdb, batch_keccak=mode_marker))
+    genesis = Genesis(
+        config=cfg,
+        gas_limit=params.CORTINA_GAS_LIMIT,
+        alloc={a: GenesisAccount(balance=FUND) for a in ADDRS},
+    )
+    return BlockChain(
+        diskdb,
+        CacheConfig(pruning=True),
+        cfg,
+        genesis,
+        new_dummy_engine(),
+        state_database=state_db,
+    )
+
+
+def create_tx(nonce, key, base_fee, seed):
+    tx = Transaction(
+        type=2, chain_id=CHAIN_ID, nonce=nonce, max_fee=base_fee * 2,
+        max_priority_fee=0, gas=800_000, to=None, value=0,
+        data=storage_init_code(seed),
+    )
+    return Signer(CHAIN_ID).sign(tx, key)
+
+
+def transfer_tx(nonce, to, key, base_fee):
+    tx = Transaction(
+        type=2, chain_id=CHAIN_ID, nonce=nonce, max_fee=base_fee * 2,
+        max_priority_fee=0, gas=21000, to=to, value=1000,
+    )
+    return Signer(CHAIN_ID).sign(tx, key)
+
+
+def test_planned_mode_chain_parity_with_storage(monkeypatch):
+    from coreth_tpu.ops.device import PlannedModeKeccak
+    from coreth_tpu.ops.keccak_jax import BatchedKeccak
+
+    counter = PlannedRunCounter()
+    counter.install(monkeypatch)
+
+    planned_chain = make_chain(PlannedModeKeccak(BatchedKeccak().digests))
+    shadow_chain = make_chain(None)  # recursive CPU hasher everywhere
+    base_fee = params.APRICOT_PHASE3_INITIAL_BASE_FEE
+
+    def gen(i, bg):
+        bf = bg.base_fee() or base_fee
+        for j, key in enumerate(KEYS):
+            if i == 0:
+                # block 1: every sender deploys a storage-writing contract
+                bg.add_tx(create_tx(i, key, bf, seed=j))
+            else:
+                # block 2: plain balance churn on top of existing storage
+                to = (0x7000 + i * N_SENDERS + j).to_bytes(20, "big")
+                bg.add_tx(transfer_tx(i, to, key, bf))
+
+    blocks, _ = generate_chain(
+        planned_chain.config, planned_chain.current_block,
+        planned_chain.engine, planned_chain.state_database, 2, gen=gen,
+    )
+    assert counter.runs > 0, "planned path never engaged: grow the workload"
+
+    for chain in (planned_chain, shadow_chain):
+        for b in blocks:
+            chain.insert_block(b)
+            chain.accept(b)
+        chain.drain_acceptor_queue()
+
+    assert planned_chain.current_block.hash() == shadow_chain.current_block.hash()
+    assert planned_chain.current_block.root == shadow_chain.current_block.root
+
+    # the deployed storage must be readable back through the planned chain
+    state = planned_chain.state_at(planned_chain.current_block.root)
+    found = 0
+    for j in range(N_SENDERS):
+        # contract address of sender j's nonce-0 creation
+        from coreth_tpu.core.types import create_address
+
+        ca = create_address(ADDRS[j], 0)
+        for s in range(SLOTS_PER_CONTRACT):
+            v = state.get_state(ca, s.to_bytes(32, "big"))
+            exp = ((j * 31 + s * 7 + 1) % 256) or 1
+            assert int.from_bytes(v, "big") == exp
+            found += 1
+    assert found == N_SENDERS * SLOTS_PER_CONTRACT
+
+
+def test_auto_mode_resolves_planned():
+    """"auto" now hands the chain the planned marker (the fast path is the
+    default path), still callable as a plain batch keccak."""
+    from coreth_tpu.ops import device
+    from coreth_tpu.ops.keccak_jax import BatchedKeccak
+
+    # bypass lazy backend resolution: inject a working batched fn
+    device._cached["fn"] = BatchedKeccak().digests
+    try:
+        fn = device.get_batch_keccak("auto")
+        assert getattr(fn, "planned", False)
+        assert getattr(device.get_batch_keccak("planned"), "planned", False)
+        from coreth_tpu.ops.keccak_ref import keccak256 as ref
+
+        assert fn([b"abc", b""]) == [ref(b"abc"), ref(b"")]
+    finally:
+        device._cached.clear()
+
+
+def test_vm_config_accepts_planned():
+    from coreth_tpu.vm.config import parse_config
+
+    assert parse_config(b'{"device-hasher": "planned"}').device_hasher == "planned"
