@@ -242,18 +242,116 @@ def bench_5():
           total_bytes / dev_s / 1e6, "MB/s", cpu_s / dev_s)
 
 
+def bench_6():
+    """Chain-level blocks/sec through insert_block: device_hasher=planned
+    vs the CPU recursive hasher, identical blocks (VERDICT r2 #1's chain
+    bench — measures the production path, not a standalone commit)."""
+    from coreth_tpu import params
+    from coreth_tpu.consensus.dummy import new_dummy_engine
+    from coreth_tpu.core.blockchain import BlockChain, CacheConfig
+    from coreth_tpu.core.chain_makers import generate_chain
+    from coreth_tpu.core.genesis import Genesis, GenesisAccount
+    from coreth_tpu.core.types import Signer, Transaction
+    from coreth_tpu.crypto.secp256k1 import priv_to_address
+    from coreth_tpu.ethdb import MemoryDB
+    from coreth_tpu.ops.device import PlannedModeKeccak
+    from coreth_tpu.ops.keccak_jax import BatchedKeccak
+    from coreth_tpu.state.database import Database
+    from coreth_tpu.trie.triedb import TrieDatabase
+
+    n_senders = int(os.environ.get("CORETH_TPU_BENCH_CHAIN_SENDERS", "400"))
+    n_blocks = int(os.environ.get("CORETH_TPU_BENCH_CHAIN_BLOCKS", "4"))
+    keys = [i.to_bytes(2, "big") * 16 for i in range(1, n_senders + 1)]
+    addrs = [priv_to_address(k) for k in keys]
+    signer = Signer(43112)
+
+    def make_chain(marker):
+        diskdb = MemoryDB()
+        genesis = Genesis(
+            config=params.TEST_CHAIN_CONFIG,
+            gas_limit=params.CORTINA_GAS_LIMIT,
+            alloc={a: GenesisAccount(balance=10**21) for a in addrs},
+        )
+        return BlockChain(
+            diskdb, CacheConfig(pruning=True), params.TEST_CHAIN_CONFIG,
+            genesis, new_dummy_engine(),
+            state_database=Database(TrieDatabase(diskdb, batch_keccak=marker)),
+        )
+
+    def gen(i, bg):
+        bf = bg.base_fee() or params.APRICOT_PHASE3_INITIAL_BASE_FEE
+        for j, key in enumerate(keys):
+            tx = Transaction(
+                type=2, chain_id=43112, nonce=i, max_fee=bf * 2,
+                max_priority_fee=0, gas=21000,
+                to=(0xA000 + i * n_senders + j).to_bytes(20, "big"), value=1,
+            )
+            bg.add_tx(signer.sign(tx, key))
+
+    seed_chain = make_chain(None)
+    blocks, _ = generate_chain(
+        seed_chain.config, seed_chain.current_block, seed_chain.engine,
+        seed_chain.state_database, n_blocks, gen=gen,
+    )
+    seed_chain.stop()
+
+    def run(marker):
+        chain = make_chain(marker)
+        t0 = time.perf_counter()
+        for b in blocks:
+            chain.insert_block(b)
+        dt = time.perf_counter() - t0
+        tip = chain.current_block
+        chain.stop()
+        return dt, tip.root
+
+    planned_marker = PlannedModeKeccak(BatchedKeccak().digests)
+    run(planned_marker)  # warm compile
+    dev_s, dev_root = run(planned_marker)
+    cpu_s, cpu_root = run(None)
+    assert dev_root == cpu_root
+    _emit(6, "chain_insert_blocks_per_sec", n_blocks / dev_s, "blocks/s",
+          cpu_s / dev_s)
+
+
+def bench_7():
+    """Incremental churn commits on a warm 1M trie (bench.py's
+    incremental leg as a standalone config)."""
+    from bench import PhaseWatchdog, run_incremental
+
+    wd = PhaseWatchdog(time.monotonic() + 1800)
+    out = run_incremental(wd, None)
+    wd.cancel()
+    if "inc_tpu_nodes_per_sec" in out:
+        _emit(7, "incremental_commit_nodes_per_sec",
+              out["inc_tpu_nodes_per_sec"], "nodes/s", out["inc_vs_cpu"])
+    else:
+        print(json.dumps({"config": 7, **out}), flush=True)
+
+
 def main():
     from coreth_tpu.utils import enable_compilation_cache
 
     enable_compilation_cache()
-    # the device-leg configs (1, 2, 5) hang forever if the tunnel wedges;
-    # reuse bench.py's watchdog so the driver gets a diagnostic line
-    from bench import _arm_watchdog
+    plat = os.environ.get("CORETH_TPU_BENCH_PLATFORM")
+    if plat:  # CPU smoke runs (the ambient sitecustomize pins axon)
+        import jax
 
-    watchdog = _arm_watchdog(
-        float(os.environ.get("CORETH_TPU_BENCH_WATCHDOG", "540")))
-    picks = [int(a) for a in sys.argv[1:]] or [1, 2, 3, 4, 5]
+        jax.config.update("jax_platforms", plat)
+    # the device-leg configs hang forever if the tunnel wedges; bench.py's
+    # phase watchdog emits a diagnostic line and exits instead
+    from bench import REPORT, PhaseWatchdog
+
+    REPORT["suite"] = "bench_suite"
+    watchdog = PhaseWatchdog(
+        time.monotonic() + float(os.environ.get("CORETH_TPU_BENCH_WATCHDOG",
+                                                "1800")))
+    picks = [int(a) for a in sys.argv[1:]] or [1, 2, 3, 4, 5, 6, 7]
     for i in picks:
+        # config 7 runs bench.py's incremental leg under its own phase
+        # watchdog with larger budgets (900s cold warmup); the outer arm
+        # must not undercut it
+        watchdog.arm(f"config-{i}", 1500 if i == 7 else 600)
         globals()[f"bench_{i}"]()
     watchdog.cancel()
 

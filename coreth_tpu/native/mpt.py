@@ -254,3 +254,178 @@ def items_to_arrays(items: Sequence[Tuple[bytes, bytes]]):
 def plan_from_items(items: Sequence[Tuple[bytes, bytes]]) -> CommitPlan:
     """Convenience: plan_commit over items_to_arrays(items)."""
     return plan_commit(*items_to_arrays(items))
+
+
+# ---------------------------------------------------------------------------
+# Incremental trie (native/mpt_inc.cpp): device-resident commits
+# ---------------------------------------------------------------------------
+
+_INC_SRC = os.path.join(_DIR, "mpt_inc.cpp")
+_INC_LIB = os.path.join(_DIR, "libmpt_inc.so")
+_inc_lib = None
+_inc_load_failed = False
+
+
+def load_inc():
+    global _inc_lib, _inc_load_failed
+    if _inc_lib is not None or _inc_load_failed:
+        return _inc_lib
+    with _lock:
+        if _inc_lib is not None or _inc_load_failed:
+            return _inc_lib
+        from ._build import build_and_load
+
+        lib = build_and_load(_INC_SRC, _INC_LIB)
+        if lib is None:
+            _inc_load_failed = True
+            return None
+        lib.mpt_inc_new.restype = ctypes.c_void_p
+        lib.mpt_inc_new.argtypes = [_u8p, _u8p, _u64p, ctypes.c_uint64]
+        lib.mpt_inc_update.restype = ctypes.c_uint64
+        lib.mpt_inc_update.argtypes = [
+            ctypes.c_void_p, _u8p, _u8p, _u64p, ctypes.c_uint64,
+        ]
+        for name in ("mpt_inc_plan", "mpt_inc_flat_bytes", "mpt_inc_num_nodes",
+                     "mpt_inc_num_dirty", "mpt_inc_total_lanes",
+                     "mpt_inc_total_patches"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_uint64
+            fn.argtypes = [ctypes.c_void_p]
+        lib.mpt_inc_root_pos.restype = ctypes.c_int32
+        lib.mpt_inc_root_pos.argtypes = [ctypes.c_void_p]
+        lib.mpt_inc_flat_ptr.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.mpt_inc_flat_ptr.argtypes = [ctypes.c_void_p]
+        lib.mpt_inc_specs.restype = None
+        lib.mpt_inc_specs.argtypes = [ctypes.c_void_p, _i32p]
+        lib.mpt_inc_word_patches.restype = None
+        lib.mpt_inc_word_patches.argtypes = [ctypes.c_void_p, _i32p, _i32p, _i32p]
+        lib.mpt_inc_execute_cpu.restype = None
+        lib.mpt_inc_execute_cpu.argtypes = [ctypes.c_void_p, ctypes.c_int, _u8p]
+        lib.mpt_inc_absorb.restype = None
+        lib.mpt_inc_absorb.argtypes = [ctypes.c_void_p, _u8p, _u8p]
+        lib.mpt_inc_root.restype = None
+        lib.mpt_inc_root.argtypes = [ctypes.c_void_p, _u8p]
+        lib.mpt_inc_free.restype = None
+        lib.mpt_inc_free.argtypes = [ctypes.c_void_p]
+        _inc_lib = lib
+        return _inc_lib
+
+
+EMPTY_ROOT = bytes.fromhex(
+    "56e81f171bcc55a6ff8345e692c0f86e5b48e01b996cadc001622fb5e363b421"
+)
+
+
+class IncrementalTrie:
+    """Persistent native MPT with per-commit dirty-subtree planning.
+
+    The TPU-native analog of the reference's warm trie + dirty-only
+    re-hash (trie/trie.go:573-626 + triedb/hashdb): the tree and its
+    digest cache live across commits; each commit plans, ships, and
+    hashes ONLY the dirty subtree. commit_cpu() is the incremental host
+    baseline/oracle; commit_device() drains the mini-plan through the
+    same PlannedCommit executor the chain uses.
+    """
+
+    def __init__(self, items: Sequence[Tuple[bytes, bytes]] = ()):
+        lib = load_inc()
+        if lib is None:
+            raise RuntimeError("native incremental planner unavailable")
+        self._lib = lib
+        keys, vals, off = items_to_arrays(items) if items else (
+            np.zeros((0, 32), np.uint8), b"", np.zeros(1, np.uint64))
+        blob = np.frombuffer(vals, dtype=np.uint8) if vals else np.zeros(1, np.uint8)
+        self._h = lib.mpt_inc_new(
+            np.ascontiguousarray(keys.reshape(-1)),
+            np.ascontiguousarray(blob),
+            np.ascontiguousarray(off, dtype=np.uint64),
+            keys.shape[0],
+        )
+        if not self._h:
+            raise ValueError("unsorted or duplicate keys")
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._lib.mpt_inc_free(h)
+
+    def update(self, items: Sequence[Tuple[bytes, bytes]]) -> int:
+        """Apply (key32, value) updates; empty value deletes. Returns the
+        number of keys that actually changed the trie."""
+        n = len(items)
+        if n == 0:
+            return 0
+        keys = np.frombuffer(b"".join(k for k, _ in items), np.uint8)
+        vals = b"".join(v for _, v in items)
+        blob = np.frombuffer(vals, np.uint8) if vals else np.zeros(1, np.uint8)
+        off = np.zeros(n + 1, np.uint64)
+        np.cumsum(np.fromiter((len(v) for _, v in items), np.uint64, count=n),
+                  out=off[1:])
+        return int(self._lib.mpt_inc_update(
+            self._h, np.ascontiguousarray(keys), np.ascontiguousarray(blob),
+            off, n))
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self._lib.mpt_inc_num_nodes(self._h))
+
+    def _export_plan(self):
+        from ..ops.keccak_fused import SegmentSpec
+
+        lib, h = self._lib, self._h
+        n_seg = int(lib.mpt_inc_plan(h))
+        if n_seg == 0:
+            return None
+        specs_arr = np.empty((n_seg, 4), np.int32)
+        lib.mpt_inc_specs(h, specs_arr.reshape(-1))
+        specs = tuple(SegmentSpec(int(a), int(b), int(c), int(d))
+                      for a, b, c, d in specs_arr)
+        n_bytes = int(lib.mpt_inc_flat_bytes(h))
+        ptr = lib.mpt_inc_flat_ptr(h)
+        flat_words = np.ctypeslib.as_array(ptr, shape=(n_bytes,)).view(np.uint32)
+        n_pat = int(lib.mpt_inc_total_patches(h))
+        dst = np.empty(n_pat, np.int32)
+        child = np.empty(n_pat, np.int32)
+        shift = np.empty(n_pat, np.int32)
+        lib.mpt_inc_word_patches(h, dst, child, shift)
+        return specs, flat_words, dst, child, shift, int(lib.mpt_inc_root_pos(h))
+
+    def commit_cpu(self, threads: int = 1) -> bytes:
+        """Incremental host commit; returns the 32-byte root."""
+        if self._lib.mpt_inc_plan(self._h) == 0:
+            return self.root()
+        out = np.empty(32, np.uint8)
+        self._lib.mpt_inc_execute_cpu(self._h, threads, out)
+        return out.tobytes()
+
+    def commit_device(self, planned=None) -> bytes:
+        """Incremental device commit through ops/keccak_planned; h2d is
+        O(dirty set), digests read back into the native cache."""
+        exported = self._export_plan()
+        if exported is None:
+            return self.root()
+        specs, flat_words, dst, child, shift, root_pos = exported
+        if planned is None:
+            from ..ops.keccak_planned import default_planned_commit
+
+            planned = default_planned_commit()
+        _root, dig = planned.run(specs, flat_words, dst, child, shift,
+                                 root_pos, want_digests=True)
+        dig8 = np.ascontiguousarray(dig).view(np.uint8).reshape(-1, 32)
+        out = np.empty(32, np.uint8)
+        self._lib.mpt_inc_absorb(
+            self._h, np.ascontiguousarray(dig8.reshape(-1)), out)
+        return out.tobytes()
+
+    def dirty_stats(self):
+        """(dirty hashed nodes, mini-plan bytes) of the CURRENT plan —
+        call right after commit planning to size the transfer."""
+        return (int(self._lib.mpt_inc_num_dirty(self._h)),
+                int(self._lib.mpt_inc_flat_bytes(self._h)))
+
+    def root(self) -> bytes:
+        if self.num_nodes == 0:
+            return EMPTY_ROOT
+        out = np.empty(32, np.uint8)
+        self._lib.mpt_inc_root(self._h, out)
+        return out.tobytes()

@@ -56,19 +56,27 @@ _WPB = _RATE // 4  # u32 words per rate block
 
 
 def _pad_lanes(n: int) -> int:
-    """Lane-count bucket: pow2 up to 8192, then 8192 multiples (matches the
-    native planner so jit programs are shared between both producers)."""
-    if n <= 0:
-        return 0
+    """Lane-count bucket — IDENTICAL to the native planners' round_lanes
+    (count+1 scratch lane, pow2 floor 16 up to 8192, then 8192 multiples):
+    PlannedCommit's step programs are jit-keyed on (lanes, blocks, npatch),
+    so matching the rounding lets the chain builder, bench full-commit
+    legs, and the incremental planner share one compiled program set."""
+    n = n + 1  # scratch lane, as the native layout reserves
     if n <= 8192:
-        return 1 << (n - 1).bit_length()
+        p = 16
+        while p < n:
+            p <<= 1
+        return p
     return ((n + 8191) // 8192) * 8192
 
 
 def _pad_patches(n: int) -> int:
     if n == 0:
         return 0
-    return 1 << (n - 1).bit_length()
+    p = 16
+    while p < n:
+        p <<= 1
+    return p
 
 
 class _TrieEntry:
