@@ -451,6 +451,76 @@ class BlockChain:
             self.trie_writer.insert_trie(blk)
             self.trie_writer.accept_trie(blk)
 
+    def populate_missing_tries(self, from_height: int,
+                               parallelism: int = 1024) -> int:
+        """Heal trie gaps in an archival chain (blockchain.go:1899
+        populateMissingTries): scan canonical blocks from [from_height] to
+        the current tip; any block whose state root is missing is
+        re-executed from its parent's state and committed to disk.
+
+        Execution is inherently sequential (block k needs block k-1's
+        state), so — like the reference, whose parallelism knob feeds the
+        trie-read prefetcher — [parallelism] drives a read-ahead pool that
+        concurrently loads upcoming blocks and warms their sender
+        recoveries (the batched-ecrecover cost) while the current block
+        executes. Returns the number of healed blocks.
+        """
+        import concurrent.futures as _fut
+
+        tip = self.last_accepted.number
+        if from_height > tip:
+            return 0
+        pool = _fut.ThreadPoolExecutor(
+            max_workers=max(1, min(parallelism, 16)))
+        window = max(1, min(parallelism, 64))
+
+        def load_and_warm(num: int):
+            blk = self.get_block_by_number(num)
+            if blk is not None:
+                for tx in blk.transactions:
+                    try:
+                        tx.sender()  # caches the recovered sender
+                    except Exception:
+                        pass
+            return blk
+
+        healed = 0
+        try:
+            pending = {
+                n: pool.submit(load_and_warm, n)
+                for n in range(from_height, min(from_height + window, tip + 1))
+            }
+            for num in range(from_height, tip + 1):
+                fut = pending.pop(num, None)
+                blk = fut.result() if fut else self.get_block_by_number(num)
+                # keep the read-ahead window full
+                head = max(pending) + 1 if pending else num + 1
+                while head <= tip and len(pending) < window:
+                    pending[head] = pool.submit(load_and_warm, head)
+                    head += 1
+                if blk is None:
+                    raise ChainError(f"canonical block {num} missing")
+                if self.has_state(blk.root):
+                    continue
+                parent = self.get_header(blk.parent_hash)
+                if parent is None or not self.has_state(parent.root):
+                    raise ChainError(
+                        f"cannot heal block {num}: parent state unavailable"
+                    )
+                statedb = StateDB(parent.root, self.state_database)
+                receipts, _, used_gas = self.processor.process(
+                    blk, parent, statedb)
+                self.validator.validate_state(blk, statedb, receipts, used_gas)
+                root = statedb.commit(self.config.is_eip158(blk.number))
+                if root != blk.root:
+                    raise ChainError(f"healed root mismatch at {num}")
+                # archival heal: persist the regenerated trie immediately
+                self.state_database.triedb.commit(root)
+                healed += 1
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
+        return healed
+
     # ------------------------------------------------------ accept / reject
 
     def accept(self, block: Block) -> None:

@@ -170,6 +170,20 @@ class CommitPlan:
         self._lib.mpt_plan_execute_cpu(self._h, threads, None, root)
         return root.tobytes()
 
+    def execute_cpu_digests(self, threads: int = 1):
+        """Host execution returning (root32, dig uint8[total_lanes, 32],
+        real_mask bool[total_lanes]) — the per-lane oracle for device
+        parity checks (pad lanes are left zero and masked out). The digest
+        pointer is declared c_void_p in load(), so this never mutates the
+        shared prototype (thread-safe vs concurrent execute_cpu)."""
+        dig = np.zeros((self.total_lanes, 32), dtype=np.uint8)
+        root = np.empty(32, dtype=np.uint8)
+        self._lib.mpt_plan_execute_cpu(
+            self._h, threads, dig.ctypes.data, root)
+        msg_len = np.empty(self.total_lanes, dtype=np.int32)
+        self._lib.mpt_plan_msg_lens(self._h, msg_len)
+        return root.tobytes(), dig, msg_len > 0
+
     def execute_device(self, impl=None) -> Tuple[bytes, np.ndarray]:
         """One fused dispatch; returns (root, dig8 uint8[total_lanes, 32])."""
         from ..ops.keccak_fused import fused_commit

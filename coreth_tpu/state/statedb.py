@@ -591,6 +591,9 @@ class StateDB:
         s.preimages = dict(self.preimages)
         s.access_list = self.access_list.copy()
         s.transient = dict(self.transient)
+        # the copy never inherits the prefetcher: it is tied to the parent's
+        # lifecycle (geth statedb.Copy drops it the same way)
+        s.prefetcher = None
         s.snaps = self.snaps
         s.snap = self.snap
         s._snap_destructs = set(self._snap_destructs)

@@ -86,10 +86,23 @@ class SyncClient:
             return
         proof_db = {keccak256(b): b for b in resp.proof_vals}
         first = req.start if req.start else (resp.keys[0] if resp.keys else b"\x00" * 32)
+        if req.end and not resp.keys:
+            # end-bounded segment drained: the zero-key edge proof can only
+            # express "no keys AT OR AFTER first" over the whole trie —
+            # keys legitimately exist past the segment's end, so that check
+            # would always fail here. Truncation inside the segment cannot
+            # hide: the segmented syncer re-derives the FULL-keyspace root
+            # from the buffered leaves and rejects any gap.
+            return
         last = resp.keys[-1] if resp.keys else first
         has_more = verify_range_proof(
             req.root, first, last, resp.keys, resp.vals, proof_db
         )
+        if req.end:
+            # beyond-`last` elements may lie outside the requested segment;
+            # the proof cannot distinguish them, so keep the server's flag
+            # (same gap-catch as above: the rebuild root check is terminal)
+            return
         # Trust the proof, never the peer: overwrite the server-supplied flag
         # with the proof-derived one (parseLeafsResponse in the reference sets
         # More = hasRightElement). A malicious more=False would otherwise

@@ -1,13 +1,29 @@
 """EVM state sync (role of /root/reference/sync/statesync/
 {state_syncer,trie_sync_tasks,trie_segments,code_syncer}.go).
 
-Downloads the account trie in range-proofed leaf batches, rebuilding
-trie nodes locally through StackTries whose completed subtrees are
-persisted as they hash (O(1) memory); each synced account schedules its
-storage trie and code hash. Large tries split into key-range segments
-fetched concurrently (trie_segments.go:65-417) — the keyspace analog of
-sequence parallelism — with per-segment progress markers in rawdb for
-resume (schema.go:108-114).
+Downloads tries as range-proofed leaf batches; each synced account
+schedules its storage trie and code hash.
+
+Small tries stream through a single StackTrie whose completed subtrees
+persist as they hash (O(1) memory, one request for the common case).
+
+Large tries (first response full with more remaining) switch to
+SEGMENTED sync — the capability of trie_segments.go:65-417, keyspace
+parallelism as the sync-time analog of sequence parallelism:
+
+  * the 256-bit keyspace splits into NUM_SEGMENTS ranges fetched
+    CONCURRENTLY, each an independent range-proofed stream
+  * every segment persists a resume marker (sync_segment_key) in the
+    same batch as the leaf data it points past, so an interrupted sync
+    resumes each segment where it stopped — markered data is always on
+    disk, unmarkered work is refetched (schema.go:108-114 semantics)
+  * leaves land in an on-disk buffer (plus the flat snapshot); when all
+    segments finish, ONE StackTrie rebuild over the ordered buffer
+    reconstructs and persists the trie nodes and must reproduce the
+    target root bit-exactly (stronger than the reference's per-segment
+    stitching: the final root check covers the whole keyspace even
+    across resumes). The rebuild is idempotent — a crash during it
+    replays from the still-markered buffer.
 """
 
 from __future__ import annotations
@@ -34,6 +50,12 @@ DEFAULT_LEAF_LIMIT = 1024
 # progress markers (core/rawdb/schema.go sync_storage/sync_segments)
 SYNC_SEGMENT_PREFIX = b"sync_segments"
 SYNC_STORAGE_PREFIX = b"sync_storage"
+# temporary raw-leaf buffer for segmented rebuilds (deleted after the
+# StackTrie pass verifies the root)
+SYNC_LEAF_PREFIX = b"sync_leafbuf"
+
+# segment marker values: b"D" done, b"S" + next_start in progress
+_SEG_DONE = b"D"
 
 
 def sync_segment_key(root: bytes, start: bytes) -> bytes:
@@ -42,6 +64,10 @@ def sync_segment_key(root: bytes, start: bytes) -> bytes:
 
 def sync_storage_key(root: bytes, account_hash: bytes) -> bytes:
     return SYNC_STORAGE_PREFIX + root + account_hash
+
+
+def sync_leaf_key(root: bytes, leaf_key: bytes) -> bytes:
+    return SYNC_LEAF_PREFIX + root + leaf_key
 
 
 class StateSyncError(Exception):
@@ -74,10 +100,18 @@ class StateSyncer:
     # --- trie leaf streaming ---------------------------------------------
 
     def _sync_trie(self, root: bytes, on_leaf, account: bytes = b"") -> int:
-        """Fetch one trie's leaves [whole range], persisting rebuilt nodes.
-        Returns the leaf count."""
+        """Fetch one trie's leaves, persisting rebuilt nodes; returns the
+        leaf count. Small tries stream through one StackTrie; large tries
+        (first response full + more) switch to concurrent segments."""
         if root == EMPTY_ROOT:
             return 0
+
+        # a previously-interrupted SEGMENTED sync resumes segmented
+        seg_starts = _segment_bounds(NUM_SEGMENTS)
+        if any(self.diskdb.get(sync_segment_key(root, s)) is not None
+               for s in seg_starts):
+            return self._sync_trie_segmented(root, on_leaf)
+
         batch = self.diskdb.new_batch()
 
         def write_node(path: bytes, node_hash: bytes, blob: bytes) -> None:
@@ -86,7 +120,7 @@ class StateSyncer:
         st = StackTrie(write_fn=write_node)
         count = 0
         start = b""
-        # resume from a previous partial sync (schema sync_storage markers)
+        # resume from a previous partial UNSEGMENTED sync
         marker = self.diskdb.get(sync_storage_key(root, account))
         resumed = marker is not None
         if marker:
@@ -96,9 +130,22 @@ class StateSyncer:
             for k, v in zip(resp.keys, resp.vals):
                 st.update(k, v)
                 on_leaf(k, v, batch)
+                # buffered until the trie proves small: the segmented
+                # switch needs every leaf fetched so far on disk
+                if not resumed:
+                    batch.put(sync_leaf_key(root, k), v)
                 count += 1
             if not resp.more or not resp.keys:
                 break
+            if not resumed and count >= self.segment_threshold:
+                # the trie IS large (>= threshold leaves and more coming):
+                # mark segment coverage relative to what the single stream
+                # already buffered, then go concurrent. Resumed pre-switch
+                # syncs never take this path (their early leaves were not
+                # buffered).
+                batch.delete(sync_storage_key(root, account))
+                self._seed_segments(root, resp.keys[-1], seg_starts, batch)
+                return self._sync_trie_segmented(root, on_leaf)
             start = _next_key(resp.keys[-1])
             # Commit the progress marker IN THE SAME batch as the leaf data it
             # points past (trie_sync_tasks.go batch+marker commit): a crash can
@@ -115,6 +162,131 @@ class StateSyncer:
                 f"rebuilt root mismatch: want {root.hex()[:12]} got {got.hex()[:12]}"
             )
         batch.delete(sync_storage_key(root, account))
+        batch.write()
+        if not resumed and count > 0:
+            self._clear_leaf_buffer(root)
+        return count
+
+    # --- segmented path (trie_segments.go:65-417 capability) ---------------
+
+    def _seed_segments(self, root: bytes, last_key: bytes, seg_starts,
+                       batch) -> None:
+        """Mark every segment done/in-progress/virgin relative to the last
+        single-stream key, in the same batch as that stream's final leaf
+        data (all earlier leaves are already buffered+committed)."""
+        nxt = _next_key(last_key)
+        ends = _segment_ends(seg_starts)
+        for i, s in enumerate(seg_starts):
+            if ends[i] <= last_key:
+                batch.put(sync_segment_key(root, s), _SEG_DONE)
+            elif s <= last_key:
+                batch.put(sync_segment_key(root, s), b"S" + nxt)
+            else:
+                batch.put(sync_segment_key(root, s), b"S" + s)
+        batch.write()
+
+    def _sync_trie_segmented(self, root: bytes, on_leaf) -> int:
+        seg_starts = _segment_bounds(NUM_SEGMENTS)
+        ends = _segment_ends(seg_starts)
+        with ThreadPoolExecutor(max_workers=NUM_SEGMENTS) as seg_pool:
+            futures = [
+                seg_pool.submit(self._fetch_segment, root, on_leaf, s, e)
+                for s, e in zip(seg_starts, ends)
+            ]
+            fetched = sum(f.result() for f in futures)
+        count = self._rebuild_from_buffer(root, seg_starts, on_leaf)
+        return count if count else fetched
+
+    def _clear_leaf_buffer(self, root: bytes) -> None:
+        """Drop buffered leaves for a trie that completed single-stream
+        (or stray entries from an older aborted sync of the same root)."""
+        batch = self.diskdb.new_batch()
+        n = 0
+        for full_key, _v in self.diskdb.iterate(SYNC_LEAF_PREFIX + root):
+            batch.delete(full_key)
+            n += 1
+            if n % 4096 == 0:
+                batch.write()
+                batch = self.diskdb.new_batch()
+        batch.write()
+
+    def _fetch_segment(self, root: bytes, on_leaf, seg_start: bytes,
+                       seg_end: bytes) -> int:
+        """Stream one key-range segment; every batch lands with its resume
+        marker atomically. seg_end is the INCLUSIVE last key served."""
+        key = sync_segment_key(root, seg_start)
+        marker = self.diskdb.get(key)
+        if marker == _SEG_DONE:
+            return 0
+        start = marker[1:] if marker else seg_start
+        count = 0
+        while True:
+            resp = self.client.get_leafs(
+                root, start=start, end=seg_end, limit=self.leaf_limit)
+            batch = self.diskdb.new_batch()
+            for k, v in zip(resp.keys, resp.vals):
+                batch.put(sync_leaf_key(root, k), v)
+                on_leaf(k, v, batch)
+                count += 1
+            if not resp.more or not resp.keys:
+                batch.put(key, _SEG_DONE)
+                batch.write()
+                return count
+            start = _next_key(resp.keys[-1])
+            batch.put(key, b"S" + start)
+            batch.write()
+
+    def _rebuild_from_buffer(self, root: bytes, seg_starts, on_leaf) -> int:
+        """One ordered StackTrie pass over the buffered leaves: persists
+        the trie nodes, REPLAYS on_leaf (so a resumed sync re-derives the
+        storage/code tasks its crashed predecessor collected only in
+        memory), and verifies the root over the FULL keyspace. Cleanup
+        order is crash-safe: markers clear in the same batch as the trie
+        nodes, the buffer strictly after — a crash mid-cleanup leaves
+        either a fully-markered buffer (rebuild replays) or no markers
+        plus stray buffer entries (cleared at the next sync's switch)."""
+        batch = self.diskdb.new_batch()
+
+        def write_node(path: bytes, node_hash: bytes, blob: bytes) -> None:
+            batch.put(node_hash, blob)
+
+        st = StackTrie(write_fn=write_node)
+        prefix = SYNC_LEAF_PREFIX + root
+        buffered = []
+        count = 0
+        for full_key, v in self.diskdb.iterate(prefix):
+            leaf_key = full_key[len(prefix):]
+            st.update(leaf_key, v)
+            on_leaf(leaf_key, v, batch)
+            buffered.append(full_key)
+            count += 1
+        got = st.hash()
+        if got != root:
+            # a lying peer's truncated more=False can only surface here;
+            # reset the segment state so the NEXT attempt (likely against
+            # an honest peer) refetches instead of wedging forever on
+            # done-marked holes
+            reset = self.diskdb.new_batch()
+            for s in seg_starts:
+                reset.delete(sync_segment_key(root, s))
+            for fk in buffered:
+                reset.delete(fk)
+            reset.write()
+            raise StateSyncError(
+                f"segmented rebuild root mismatch: want {root.hex()[:12]} "
+                f"got {got.hex()[:12]} (segment state reset for refetch)"
+            )
+        # 1) trie nodes + replayed side effects + marker clear: one batch
+        for s in seg_starts:
+            batch.delete(sync_segment_key(root, s))
+        batch.write()
+        # 2) buffer clear, strictly after the markers are gone
+        batch = self.diskdb.new_batch()
+        for i, fk in enumerate(buffered):
+            batch.delete(fk)
+            if i % 4096 == 4095:
+                batch.write()
+                batch = self.diskdb.new_batch()
         batch.write()
         return count
 
@@ -135,14 +307,16 @@ class StateSyncer:
 
         self._sync_trie(self.root, on_account_leaf)
 
-        # storage tries (deduped by root — identical contracts share)
+        # storage tries (deduped by root — identical contracts share; owner
+        # sets dedupe the rebuild pass's on_leaf replay)
         futures = []
-        seen_roots: Dict[bytes, List[bytes]] = {}
+        seen_roots: Dict[bytes, Set[bytes]] = {}
         for account_hash, storage_root in self.storage_tasks:
-            seen_roots.setdefault(storage_root, []).append(account_hash)
+            seen_roots.setdefault(storage_root, set()).add(account_hash)
         for storage_root, owners in seen_roots.items():
             futures.append(
-                self.pool.submit(self._sync_storage_trie, storage_root, owners)
+                self.pool.submit(
+                    self._sync_storage_trie, storage_root, sorted(owners))
             )
         for f in futures:
             f.result()
@@ -173,3 +347,14 @@ def _next_key(key: bytes) -> bytes:
     """Smallest key greater than [key]."""
     v = int.from_bytes(key, "big") + 1
     return v.to_bytes(len(key), "big")
+
+
+def _segment_ends(seg_starts) -> List[bytes]:
+    """INCLUSIVE last key per segment (the wire's `end` bound is
+    inclusive; the final segment runs to the keyspace maximum)."""
+    ends = []
+    for nxt in seg_starts[1:]:
+        v = int.from_bytes(nxt, "big") - 1
+        ends.append(v.to_bytes(32, "big"))
+    ends.append(b"\xff" * 32)
+    return ends

@@ -195,6 +195,14 @@ class VM:
         self.block_builder = BlockBuilder(self)
         self.txpool.subscribe_new_txs(lambda txs: self._signal_txs_ready())
 
+        # archival trie-gap healing behind the config knob (vm.go startup
+        # order; core/blockchain.go:1899 populateMissingTries)
+        if self.full_config.populate_missing_tries is not None:
+            self.blockchain.populate_missing_tries(
+                self.full_config.populate_missing_tries,
+                self.full_config.populate_missing_tries_parallelism,
+            )
+
         # inbound sync server (vm.go:547 initializeStateSyncServer): leaf/
         # block/code requests served off this chain, snapshot fast path
         # engaged automatically when the chain runs one

@@ -1,0 +1,121 @@
+"""populate_missing_tries (core/blockchain.go:1899 capability): heal trie
+gaps in an archival chain by re-executing the affected blocks, with a
+parallel read-ahead pool warming block loads + sender recovery."""
+
+import pytest
+
+from coreth_tpu import params
+from coreth_tpu.consensus.dummy import new_dummy_engine
+from coreth_tpu.core.blockchain import BlockChain, CacheConfig, ChainError
+from coreth_tpu.core.chain_makers import generate_chain
+from coreth_tpu.core.genesis import Genesis, GenesisAccount
+from coreth_tpu.core.types import Signer, Transaction
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.state.database import Database
+from coreth_tpu.trie.triedb import TrieDatabase
+
+KEY = b"\x11" * 32
+ADDR = priv_to_address(KEY)
+N_BLOCKS = 50
+
+
+def build_archival_chain():
+    diskdb = MemoryDB()
+    genesis = Genesis(
+        config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+        alloc={ADDR: GenesisAccount(balance=10**21)},
+    )
+    chain = BlockChain(
+        diskdb, CacheConfig(pruning=False), params.TEST_CHAIN_CONFIG,
+        genesis, new_dummy_engine(),
+        state_database=Database(TrieDatabase(diskdb)),
+    )
+    signer = Signer(43112)
+
+    def gen(i, bg):
+        bf = bg.base_fee() or params.APRICOT_PHASE3_INITIAL_BASE_FEE
+        tx = Transaction(
+            type=2, chain_id=43112, nonce=i, max_fee=bf * 2,
+            max_priority_fee=0, gas=21000,
+            to=(0xB000 + i).to_bytes(20, "big"), value=7,
+        )
+        bg.add_tx(signer.sign(tx, KEY))
+
+    blocks, _ = generate_chain(
+        chain.config, chain.current_block, chain.engine,
+        chain.state_database, N_BLOCKS, gen=gen,
+    )
+    for b in blocks:
+        chain.insert_block(b)
+        chain.accept(b)
+    chain.drain_acceptor_queue()
+    return chain, blocks, diskdb
+
+
+def test_heal_deleted_interior_roots():
+    chain, blocks, diskdb = build_archival_chain()
+
+    # punch holes: delete the ROOT node blob of interior blocks
+    holes = [blocks[i] for i in (9, 10, 23, 37)]
+    for b in holes:
+        # drop from both the disk store and the triedb caches
+        diskdb.delete(b.root)
+        chain.state_database.triedb._dirties.pop(b.root, None)
+        chain.state_database.triedb._cleans.pop(b.root, None)
+        assert not chain.has_state(b.root)
+
+    healed = chain.populate_missing_tries(1, parallelism=8)
+    assert healed == len(holes)
+    for b in holes:
+        assert chain.has_state(b.root)
+        # the healed state is actually readable
+        st = chain.state_at(b.root)
+        assert st.get_nonce(ADDR) == b.number
+    chain.stop()
+
+
+def test_noop_when_no_gaps():
+    chain, _blocks, _ = build_archival_chain()
+    assert chain.populate_missing_tries(1, parallelism=4) == 0
+    chain.stop()
+
+
+def _drop_root(chain, diskdb, block):
+    diskdb.delete(block.root)
+    chain.state_database.triedb._dirties.pop(block.root, None)
+    chain.state_database.triedb._cleans.pop(block.root, None)
+
+
+def test_consecutive_holes_heal_forward():
+    chain, blocks, diskdb = build_archival_chain()
+    # two CONSECUTIVE holes: block k+1's heal runs after k's, forward pass
+    for b in blocks[19:21]:
+        _drop_root(chain, diskdb, b)
+    assert chain.populate_missing_tries(1, parallelism=4) == 2
+    for b in blocks[19:21]:
+        assert chain.has_state(b.root)
+    chain.stop()
+
+
+def test_unhealable_gap_raises():
+    chain, blocks, diskdb = build_archival_chain()
+    # blocks[29]/blocks[30] are heights 30/31; drop both roots but start
+    # the scan AT 31: its parent state (30) is missing and out of scope
+    _drop_root(chain, diskdb, blocks[29])
+    _drop_root(chain, diskdb, blocks[30])
+    with pytest.raises(ChainError):
+        chain.populate_missing_tries(blocks[30].number, parallelism=4)
+    chain.stop()
+
+
+def test_config_knob_wired():
+    """VM initialize runs the heal when the knob is set (no pruning)."""
+    from coreth_tpu.vm.config import parse_config
+
+    cfg = parse_config(
+        b'{"pruning-enabled": false, "populate-missing-tries": 1,'
+        b' "populate-missing-tries-parallelism": 4}'
+    )
+    assert cfg.populate_missing_tries == 1
+    assert cfg.populate_missing_tries_parallelism == 4

@@ -217,7 +217,7 @@ class TestHandlersAndClient:
         root = server.blockchain.last_accepted.root
         resp = client.get_leafs(root, limit=1)
         assert resp.more  # honest partial response
-        req = LeafsRequest(root, b"", b"", 1)
+        req = LeafsRequest(root, limit=1)
         resp.more = False  # malicious truncation
         client._verify_leafs(req, resp)
         assert resp.more is True  # proof wins over the peer's claim
