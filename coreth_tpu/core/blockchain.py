@@ -441,15 +441,26 @@ class BlockChain:
                 raise ChainError("missing ancestor during state reprocess")
             cur = parent
         for blk in reversed(missing):
-            parent = self.get_header(blk.parent_hash)
-            statedb = StateDB(parent.root, self.state_database)
-            receipts, _, used_gas = self.processor.process(blk, parent, statedb)
-            self.validator.validate_state(blk, statedb, receipts, used_gas)
-            root = statedb.commit(self.config.is_eip158(blk.number))
-            if root != blk.root:
-                raise ChainError("reprocessed root mismatch")
+            self._reexecute_and_commit(blk)
             self.trie_writer.insert_trie(blk)
             self.trie_writer.accept_trie(blk)
+
+    def _reexecute_and_commit(self, blk: Block) -> bytes:
+        """Re-run [blk] from its parent's state, validate, and commit the
+        regenerated root into the forest (shared by reprocess_state and
+        populate_missing_tries — one re-execution path to maintain)."""
+        parent = self.get_header(blk.parent_hash)
+        if parent is None or not self.has_state(parent.root):
+            raise ChainError(
+                f"cannot re-execute block {blk.number}: parent state unavailable"
+            )
+        statedb = StateDB(parent.root, self.state_database)
+        receipts, _, used_gas = self.processor.process(blk, parent, statedb)
+        self.validator.validate_state(blk, statedb, receipts, used_gas)
+        root = statedb.commit(self.config.is_eip158(blk.number))
+        if root != blk.root:
+            raise ChainError(f"re-executed root mismatch at {blk.number}")
+        return root
 
     def populate_missing_tries(self, from_height: int,
                                parallelism: int = 1024) -> int:
@@ -502,18 +513,7 @@ class BlockChain:
                     raise ChainError(f"canonical block {num} missing")
                 if self.has_state(blk.root):
                     continue
-                parent = self.get_header(blk.parent_hash)
-                if parent is None or not self.has_state(parent.root):
-                    raise ChainError(
-                        f"cannot heal block {num}: parent state unavailable"
-                    )
-                statedb = StateDB(parent.root, self.state_database)
-                receipts, _, used_gas = self.processor.process(
-                    blk, parent, statedb)
-                self.validator.validate_state(blk, statedb, receipts, used_gas)
-                root = statedb.commit(self.config.is_eip158(blk.number))
-                if root != blk.root:
-                    raise ChainError(f"healed root mismatch at {num}")
+                root = self._reexecute_and_commit(blk)
                 # archival heal: persist the regenerated trie immediately
                 self.state_database.triedb.commit(root)
                 healed += 1

@@ -125,26 +125,28 @@ class StateSyncer:
         resumed = marker is not None
         if marker:
             start = marker
+        # pre-switch leaves held in MEMORY (bounded by segment_threshold):
+        # small tries — the overwhelmingly common case — never touch the
+        # disk buffer; the leaves flush into it only at the actual switch
+        pre_switch: List = [] if not resumed else None
         while True:
             resp = self.client.get_leafs(root, start=start, limit=self.leaf_limit)
             for k, v in zip(resp.keys, resp.vals):
                 st.update(k, v)
                 on_leaf(k, v, batch)
-                # buffered until the trie proves small: the segmented
-                # switch needs every leaf fetched so far on disk
-                if not resumed:
-                    batch.put(sync_leaf_key(root, k), v)
+                if pre_switch is not None:
+                    pre_switch.append((k, v))
                 count += 1
             if not resp.more or not resp.keys:
                 break
-            if not resumed and count >= self.segment_threshold:
+            if pre_switch is not None and count >= self.segment_threshold:
                 # the trie IS large (>= threshold leaves and more coming):
-                # mark segment coverage relative to what the single stream
-                # already buffered, then go concurrent. Resumed pre-switch
-                # syncs never take this path (their early leaves were not
-                # buffered).
+                # buffer everything fetched so far + mark segment coverage
+                # in one atomic batch, then go concurrent. Resumed
+                # pre-switch syncs never take this path (their early
+                # leaves were never retained).
                 batch.delete(sync_storage_key(root, account))
-                self._seed_segments(root, resp.keys[-1], seg_starts, batch)
+                self._seed_segments(root, pre_switch, seg_starts, batch)
                 return self._sync_trie_segmented(root, on_leaf)
             start = _next_key(resp.keys[-1])
             # Commit the progress marker IN THE SAME batch as the leaf data it
@@ -163,17 +165,19 @@ class StateSyncer:
             )
         batch.delete(sync_storage_key(root, account))
         batch.write()
-        if not resumed and count > 0:
-            self._clear_leaf_buffer(root)
         return count
 
     # --- segmented path (trie_segments.go:65-417 capability) ---------------
 
-    def _seed_segments(self, root: bytes, last_key: bytes, seg_starts,
+    def _seed_segments(self, root: bytes, pre_switch, seg_starts,
                        batch) -> None:
-        """Mark every segment done/in-progress/virgin relative to the last
-        single-stream key, in the same batch as that stream's final leaf
-        data (all earlier leaves are already buffered+committed)."""
+        """Flush the single-stream prefix into the disk buffer and mark
+        every segment done/in-progress/virgin relative to its last key —
+        one atomic batch, so the switch either fully happens or the
+        unsegmented marker path resumes as if it never did."""
+        for k, v in pre_switch:
+            batch.put(sync_leaf_key(root, k), v)
+        last_key = pre_switch[-1][0]
         nxt = _next_key(last_key)
         ends = _segment_ends(seg_starts)
         for i, s in enumerate(seg_starts):
@@ -220,6 +224,7 @@ class StateSyncer:
             return 0
         start = marker[1:] if marker else seg_start
         count = 0
+        empty_more = 0
         while True:
             resp = self.client.get_leafs(
                 root, start=start, end=seg_end, limit=self.leaf_limit)
@@ -228,13 +233,27 @@ class StateSyncer:
                 batch.put(sync_leaf_key(root, k), v)
                 on_leaf(k, v, batch)
                 count += 1
-            if not resp.more or not resp.keys:
-                batch.put(key, _SEG_DONE)
+            if resp.keys and resp.more:
+                start = _next_key(resp.keys[-1])
+                batch.put(key, b"S" + start)
                 batch.write()
-                return count
-            start = _next_key(resp.keys[-1])
-            batch.put(key, b"S" + start)
+                empty_more = 0
+                continue
+            if resp.more:
+                # zero keys but "more": a deadline-pressured server served
+                # nothing this round — retry the same range (bounded)
+                # instead of stamping DONE over an unfinished segment
+                batch.write()
+                empty_more += 1
+                if empty_more > 5:
+                    raise StateSyncError(
+                        f"segment {seg_start.hex()[:8]} starves: server "
+                        "keeps answering empty with more=True"
+                    )
+                continue
+            batch.put(key, _SEG_DONE)
             batch.write()
+            return count
 
     def _rebuild_from_buffer(self, root: bytes, seg_starts, on_leaf) -> int:
         """One ordered StackTrie pass over the buffered leaves: persists
@@ -252,42 +271,39 @@ class StateSyncer:
 
         st = StackTrie(write_fn=write_node)
         prefix = SYNC_LEAF_PREFIX + root
-        buffered = []
         count = 0
+        # nodes/snapshot writes stream out in chunks — hash-keyed blobs are
+        # self-verifying, so pre-verification flushes can at worst orphan
+        # garbage (same as a crash), never corrupt; memory stays O(chunk)
         for full_key, v in self.diskdb.iterate(prefix):
             leaf_key = full_key[len(prefix):]
             st.update(leaf_key, v)
             on_leaf(leaf_key, v, batch)
-            buffered.append(full_key)
             count += 1
+            if count % 4096 == 0:
+                batch.write()
+                batch = self.diskdb.new_batch()
         got = st.hash()
         if got != root:
             # a lying peer's truncated more=False can only surface here;
             # reset the segment state so the NEXT attempt (likely against
             # an honest peer) refetches instead of wedging forever on
             # done-marked holes
-            reset = self.diskdb.new_batch()
+            batch = self.diskdb.new_batch()
             for s in seg_starts:
-                reset.delete(sync_segment_key(root, s))
-            for fk in buffered:
-                reset.delete(fk)
-            reset.write()
+                batch.delete(sync_segment_key(root, s))
+            batch.write()
+            self._clear_leaf_buffer(root)
             raise StateSyncError(
                 f"segmented rebuild root mismatch: want {root.hex()[:12]} "
                 f"got {got.hex()[:12]} (segment state reset for refetch)"
             )
-        # 1) trie nodes + replayed side effects + marker clear: one batch
+        # 1) remaining nodes + replayed side effects + marker clear: one batch
         for s in seg_starts:
             batch.delete(sync_segment_key(root, s))
         batch.write()
         # 2) buffer clear, strictly after the markers are gone
-        batch = self.diskdb.new_batch()
-        for i, fk in enumerate(buffered):
-            batch.delete(fk)
-            if i % 4096 == 4095:
-                batch.write()
-                batch = self.diskdb.new_batch()
-        batch.write()
+        self._clear_leaf_buffer(root)
         return count
 
     # --- main account trie ------------------------------------------------
