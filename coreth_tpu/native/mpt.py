@@ -205,7 +205,6 @@ class CommitPlan:
 
 
 _staged_singleton = None
-_planned_singleton = None
 
 
 def _default_staged():
@@ -218,12 +217,11 @@ def _default_staged():
 
 
 def _default_planned():
-    global _planned_singleton
-    if _planned_singleton is None:
-        from ..ops.keccak_planned import PlannedCommit
+    # shared with the chain path: one program set, and the Pallas kernel
+    # engages by default on TPU backends (keccak_planned's selection)
+    from ..ops.keccak_planned import default_planned_commit
 
-        _planned_singleton = PlannedCommit()
-    return _planned_singleton
+    return default_planned_commit()
 
 
 def plan_commit(keys: np.ndarray, vals_blob: bytes,

@@ -99,10 +99,15 @@ class StateSyncer:
 
     # --- trie leaf streaming ---------------------------------------------
 
-    def _sync_trie(self, root: bytes, on_leaf, account: bytes = b"") -> int:
+    def _sync_trie(self, root: bytes, on_leaf, account: bytes = b"",
+                   on_unleaf=None) -> int:
         """Fetch one trie's leaves, persisting rebuilt nodes; returns the
         leaf count. Small tries stream through one StackTrie; large tries
-        (first response full + more) switch to concurrent segments."""
+        (>= segment_threshold leaves with more coming) switch to
+        concurrent segments. on_unleaf(key, batch) undoes on_leaf's
+        key-addressed side effects — used when discarding unverified
+        buffered leaves (lying-peer recovery) so phantom snapshot entries
+        cannot outlive the data that created them."""
         if root == EMPTY_ROOT:
             return 0
 
@@ -110,7 +115,7 @@ class StateSyncer:
         seg_starts = _segment_bounds(NUM_SEGMENTS)
         if any(self.diskdb.get(sync_segment_key(root, s)) is not None
                for s in seg_starts):
-            return self._sync_trie_segmented(root, on_leaf)
+            return self._sync_trie_segmented(root, on_leaf, on_unleaf)
 
         batch = self.diskdb.new_batch()
 
@@ -144,10 +149,13 @@ class StateSyncer:
                 # buffer everything fetched so far + mark segment coverage
                 # in one atomic batch, then go concurrent. Resumed
                 # pre-switch syncs never take this path (their early
-                # leaves were never retained).
+                # leaves were never retained). Stray buffer entries from a
+                # crashed older sync of this root are cleared (with their
+                # snapshot side effects) before the fresh seed.
+                self._clear_leaf_buffer(root, on_unleaf)
                 batch.delete(sync_storage_key(root, account))
                 self._seed_segments(root, pre_switch, seg_starts, batch)
-                return self._sync_trie_segmented(root, on_leaf)
+                return self._sync_trie_segmented(root, on_leaf, on_unleaf)
             start = _next_key(resp.keys[-1])
             # Commit the progress marker IN THE SAME batch as the leaf data it
             # points past (trie_sync_tasks.go batch+marker commit): a crash can
@@ -189,7 +197,7 @@ class StateSyncer:
                 batch.put(sync_segment_key(root, s), b"S" + s)
         batch.write()
 
-    def _sync_trie_segmented(self, root: bytes, on_leaf) -> int:
+    def _sync_trie_segmented(self, root: bytes, on_leaf, on_unleaf=None) -> int:
         seg_starts = _segment_bounds(NUM_SEGMENTS)
         ends = _segment_ends(seg_starts)
         with ThreadPoolExecutor(max_workers=NUM_SEGMENTS) as seg_pool:
@@ -198,15 +206,19 @@ class StateSyncer:
                 for s, e in zip(seg_starts, ends)
             ]
             fetched = sum(f.result() for f in futures)
-        count = self._rebuild_from_buffer(root, seg_starts, on_leaf)
+        count = self._rebuild_from_buffer(root, seg_starts, on_leaf, on_unleaf)
         return count if count else fetched
 
-    def _clear_leaf_buffer(self, root: bytes) -> None:
-        """Drop buffered leaves for a trie that completed single-stream
-        (or stray entries from an older aborted sync of the same root)."""
+    def _clear_leaf_buffer(self, root: bytes, on_unleaf=None) -> None:
+        """Drop buffered leaves for [root] — and, when discarding
+        UNVERIFIED data (on_unleaf set), undo the snapshot entries those
+        leaves wrote, so a lying peer's phantom keys don't survive."""
         batch = self.diskdb.new_batch()
         n = 0
-        for full_key, _v in self.diskdb.iterate(SYNC_LEAF_PREFIX + root):
+        prefix = SYNC_LEAF_PREFIX + root
+        for full_key, _v in self.diskdb.iterate(prefix):
+            if on_unleaf is not None:
+                on_unleaf(full_key[len(prefix):], batch)
             batch.delete(full_key)
             n += 1
             if n % 4096 == 0:
@@ -255,7 +267,8 @@ class StateSyncer:
             batch.write()
             return count
 
-    def _rebuild_from_buffer(self, root: bytes, seg_starts, on_leaf) -> int:
+    def _rebuild_from_buffer(self, root: bytes, seg_starts, on_leaf,
+                             on_unleaf=None) -> int:
         """One ordered StackTrie pass over the buffered leaves: persists
         the trie nodes, REPLAYS on_leaf (so a resumed sync re-derives the
         storage/code tasks its crashed predecessor collected only in
@@ -288,12 +301,13 @@ class StateSyncer:
             # a lying peer's truncated more=False can only surface here;
             # reset the segment state so the NEXT attempt (likely against
             # an honest peer) refetches instead of wedging forever on
-            # done-marked holes
+            # done-marked holes. The buffer clear also undoes the
+            # snapshot entries the unverified leaves wrote (on_unleaf).
             batch = self.diskdb.new_batch()
             for s in seg_starts:
                 batch.delete(sync_segment_key(root, s))
             batch.write()
-            self._clear_leaf_buffer(root)
+            self._clear_leaf_buffer(root, on_unleaf)
             raise StateSyncError(
                 f"segmented rebuild root mismatch: want {root.hex()[:12]} "
                 f"got {got.hex()[:12]} (segment state reset for refetch)"
@@ -321,7 +335,11 @@ class StateSyncer:
                 with self.lock:
                     self.code_hashes.add(acct.code_hash)
 
-        self._sync_trie(self.root, on_account_leaf)
+        def un_account_leaf(key_hash: bytes, batch) -> None:
+            batch.delete(account_snapshot_key(key_hash))
+
+        self._sync_trie(self.root, on_account_leaf,
+                        on_unleaf=un_account_leaf)
 
         # storage tries (deduped by root — identical contracts share; owner
         # sets dedupe the rebuild pass's on_leaf replay)
@@ -344,7 +362,12 @@ class StateSyncer:
             for owner in owners:
                 batch.put(storage_snapshot_key(owner, slot_hash), value)
 
-        self._sync_trie(storage_root, on_storage_leaf, account=owners[0])
+        def un_storage_leaf(slot_hash: bytes, batch) -> None:
+            for owner in owners:
+                batch.delete(storage_snapshot_key(owner, slot_hash))
+
+        self._sync_trie(storage_root, on_storage_leaf, account=owners[0],
+                        on_unleaf=un_storage_leaf)
         self.synced_storage_roots.add(storage_root)
 
     # --- code -------------------------------------------------------------

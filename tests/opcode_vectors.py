@@ -230,10 +230,8 @@ def _flow_vectors():
     #   PUSH1 1, PUSH1 dest, JUMPI, PUSH1 5, PUSH1 0, SSTORE, STOP,
     #   JUMPDEST, PUSH1 7, PUSH1 0, SSTORE, STOP
     body_skip = _push(5) + _sstore(0) + STOP
-    code_head = _push(1)
-    dest = None
-    # compute dest after head assembled: head = push1 1, push1 X, jumpi
-    head_len = len(_push(1)) + 2 + 1  # push1 X is 2 bytes, jumpi 1
+    # head = PUSH1 cond (2) + PUSH1 dest (2) + JUMPI (1)
+    head_len = len(_push(1)) + 2 + 1
     dest = head_len + len(body_skip)
     code = (_push(1) + bytes([0x60, dest, 0x57]) + body_skip
             + b"\x5b" + _push(7) + _sstore(0) + STOP)

@@ -1,0 +1,71 @@
+"""Pallas kernel CI coverage without a chip.
+
+Full interpret-mode numerics cost >10 minutes per call on the CPU
+backend (measured), so CI validates what it affordably can:
+  * the kernel TRACES — grid/block-spec construction, shape plumbing,
+    and the %1024 routing stay structurally sound (this catches the
+    common breakage class: pallas API drift, spec mismatches)
+  * the default kernel-selection policy (Pallas on TPU backends, XLA on
+    CPU, env override) resolves as documented
+On-chip numerics are covered where they can run: bench.py's warm-up
+parity probe compares Pallas vs XLA digests on the real TPU before any
+number is reported, and the full interpret-mode parity test remains
+under the `slow` marker.
+"""
+
+import numpy as np
+import pytest
+
+import coreth_tpu.ops.keccak_planned as kp
+from coreth_tpu.ops.keccak_pallas import staged_seg_impl
+
+
+def test_pallas_segment_kernel_traces():
+    import jax
+
+    impl = staged_seg_impl()
+    for lanes, blocks in [(1024, 1), (2048, 2), (4096, 4)]:
+        out = jax.eval_shape(
+            impl, jax.ShapeDtypeStruct((lanes, blocks, 34), np.uint32))
+        assert out.shape == (lanes, 8)
+        assert out.dtype == np.uint32
+    # sub-grid lane counts route to the XLA scan kernel — also traceable
+    out = jax.eval_shape(
+        impl, jax.ShapeDtypeStruct((256, 1, 34), np.uint32))
+    assert out.shape == (256, 8)
+
+
+def test_pallas_jaxpr_contains_pallas_call():
+    import jax
+
+    impl = staged_seg_impl()
+    big = str(jax.make_jaxpr(impl)(np.zeros((1024, 1, 34), np.uint32)))
+    small = str(jax.make_jaxpr(impl)(np.zeros((64, 1, 34), np.uint32)))
+    assert "pallas_call" in big, "1024-lane segment did not route to Pallas"
+    assert "pallas_call" not in small, "sub-grid segment routed to Pallas"
+
+
+def test_default_kernel_selection(monkeypatch):
+    # CPU backend (the test env): auto must NOT pick pallas
+    monkeypatch.setattr(kp, "_default_commit", None)
+    monkeypatch.delenv("CORETH_TPU_SEG_KERNEL", raising=False)
+    commit = kp.default_planned_commit()
+    assert commit._step is kp._default_step  # XLA default step
+
+    # forced pallas: a distinct step wrapping staged_seg_impl
+    monkeypatch.setattr(kp, "_default_commit", None)
+    monkeypatch.setenv("CORETH_TPU_SEG_KERNEL", "pallas")
+    commit = kp.default_planned_commit()
+    assert commit._step is not kp._default_step
+
+    # forced xla on any backend
+    monkeypatch.setattr(kp, "_default_commit", None)
+    monkeypatch.setenv("CORETH_TPU_SEG_KERNEL", "xla")
+    commit = kp.default_planned_commit()
+    assert commit._step is kp._default_step
+
+    monkeypatch.setattr(kp, "_default_commit", None)  # leave clean
+
+
+def test_tpu_backend_detection_on_cpu():
+    assert kp._tpu_backend() is False  # conftest pins the cpu platform

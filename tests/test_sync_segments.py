@@ -170,24 +170,42 @@ def test_segment_bounds_cover_keyspace():
             bounds[i + 1], "big")
 
 
-def test_tampered_segment_rebuild_rejected():
-    """A poisoned leaf buffer (wrong value smuggled in) must fail the
-    full-keyspace root check, not silently persist bad nodes."""
+def test_tampered_segment_rebuild_rejected_then_self_heals():
+    """A poisoned leaf buffer (phantom key smuggled in) must fail the
+    full-keyspace root check, undo the phantom's side effects, reset the
+    segment state — and the NEXT attempt must succeed from scratch."""
     tdb, root = build_server_state(N_BIG)
     client_db = MemoryDB()
     dying = CountingClient(make_client(tdb), die_after=3)
     with pytest.raises(ConnectionError):
         run_sync(tdb, root, client_db, dying)
-    # corrupt one buffered leaf value
+    # smuggle a PHANTOM leaf (key not in the real trie) into the buffer
     entries = list(client_db.iterate(SYNC_LEAF_PREFIX))
     assert entries
     k0, v0 = entries[0]
-    client_db.put(k0, v0 + b"\x01")
-    from coreth_tpu.sync.statesync import StateSyncError
+    phantom = k0[:-1] + bytes([k0[-1] ^ 0xFF])
+    client_db.put(phantom, v0)
+    from coreth_tpu.sync.statesync import StateSyncError, SYNC_LEAF_PREFIX as P
 
-    with pytest.raises((StateSyncError, Exception)) as ei:
-        run_sync(tdb, root, client_db, make_client(tdb))
-    assert "mismatch" in str(ei.value) or isinstance(ei.value, StateSyncError)
+    side = {}
+
+    def on_leaf(k, v, batch):
+        side[k] = v
+
+    def on_unleaf(k, batch):
+        side.pop(k, None)
+
+    s = StateSyncer(make_client(tdb), client_db, root)
+    with pytest.raises(StateSyncError, match="mismatch"):
+        s._sync_trie(root, on_leaf, on_unleaf=on_unleaf)
+    # side effects undone for every discarded buffered leaf (incl. phantom)
+    assert phantom[len(P + root):] not in side
+    # segment state fully reset
+    assert not list(client_db.iterate(SYNC_SEGMENT_PREFIX))
+    assert not list(client_db.iterate(SYNC_LEAF_PREFIX))
+    # an honest retry completes
+    count, _ = run_sync(tdb, root, client_db, make_client(tdb))
+    assert count == N_BIG
 
 
 def test_crash_before_rebuild_replays_side_effects():
