@@ -1,0 +1,70 @@
+"""Race-detection harness — the test-time analog of `go test -race`
+(SURVEY §5 concurrency discipline).
+
+Go's race detector instruments memory accesses; Python's GIL hides most
+word-level races but NOT compound-operation races (check-then-act,
+iterate-while-mutate) — exactly the class the chain's locking discipline
+must prevent. `RaceDetector.guard(obj, methods)` wraps methods so that
+any wall-clock OVERLAP of two guarded calls from different threads is
+recorded as a violation: if the owner's locks are correct, guarded
+mutators can never overlap no matter how hard tests hammer the object.
+
+Usage (tests/test_race_discipline.py):
+
+    det = RaceDetector()
+    det.guard(triedb, ["update", "commit", "dereference", "cap"])
+    ... run concurrent chain load ...
+    assert det.violations == []
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import List
+
+
+class RaceDetector:
+    def __init__(self):
+        self.violations: List[str] = []
+        self._meta = threading.Lock()
+        # (group, thread id) -> nesting depth; "any OTHER thread with
+        # depth > 0 in my group" IS the overlap condition — one source of
+        # truth, so a violation can never be masked by which thread
+        # happened to enter first
+        self._depth: dict = {}
+
+    def guard(self, obj, methods) -> None:
+        """Wrap [methods] of [obj]; overlapping entry from two threads into
+        ANY pair of them is a violation (they form one exclusion group)."""
+        group = id(obj)
+        for name in methods:
+            orig = getattr(obj, name)
+            setattr(obj, name, self._wrap(group, name, orig))
+
+    def _wrap(self, group, name, fn):
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            me = threading.get_ident()
+            with self._meta:
+                others = [
+                    t for (g, t), d in self._depth.items()
+                    if g == group and t != me and d > 0
+                ]
+                if others:
+                    self.violations.append(
+                        f"{name} entered by thread {me} while threads "
+                        f"{others} hold guarded methods"
+                    )
+                key = (group, me)
+                self._depth[key] = self._depth.get(key, 0) + 1
+            try:
+                return fn(*a, **kw)
+            finally:
+                with self._meta:
+                    key = (group, me)
+                    self._depth[key] -= 1
+                    if self._depth[key] == 0:
+                        del self._depth[key]
+
+        return wrapped

@@ -1,0 +1,193 @@
+"""Concurrency-discipline tests (SURVEY §5: the reference leans on
+`go test -race` + deterministic queue draining; this is the Python
+analog): wall-clock overlap detection on guarded mutators under real
+chain load, deterministic acceptor-drain ordering, and compound-race
+stress on the txpool.
+"""
+
+import random
+import threading
+
+from coreth_tpu import params
+from coreth_tpu.consensus.dummy import new_dummy_engine
+from coreth_tpu.core.blockchain import BlockChain, CacheConfig
+from coreth_tpu.core.chain_makers import generate_chain
+from coreth_tpu.core.genesis import Genesis, GenesisAccount
+from coreth_tpu.core.types import Signer, Transaction
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.state.database import Database
+from coreth_tpu.trie.triedb import TrieDatabase
+from coreth_tpu.utils.racecheck import RaceDetector
+
+KEY = b"\x33" * 32
+ADDR = priv_to_address(KEY)
+SIGNER = Signer(43112)
+
+
+def build_chain_and_blocks(n_blocks=24):
+    diskdb = MemoryDB()
+    genesis = Genesis(
+        config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+        alloc={ADDR: GenesisAccount(balance=10**21)},
+    )
+    chain = BlockChain(
+        diskdb, CacheConfig(pruning=True, commit_interval=4),
+        params.TEST_CHAIN_CONFIG, genesis, new_dummy_engine(),
+        state_database=Database(TrieDatabase(diskdb)),
+    )
+
+    def gen(i, bg):
+        bf = bg.base_fee() or params.APRICOT_PHASE3_INITIAL_BASE_FEE
+        tx = Transaction(
+            type=2, chain_id=43112, nonce=i, max_fee=bf * 2,
+            max_priority_fee=0, gas=21000,
+            to=(0xD000 + i).to_bytes(20, "big"), value=5,
+        )
+        bg.add_tx(SIGNER.sign(tx, KEY))
+
+    blocks, _ = generate_chain(
+        chain.config, chain.current_block, chain.engine,
+        chain.state_database, n_blocks, gen=gen,
+    )
+    return chain, blocks
+
+
+def test_detector_catches_real_overlap():
+    """Harness self-test: a deliberately unsynchronized object under
+    concurrent entry MUST produce violations — proving the chain tests
+    below aren't vacuously green."""
+
+    class Unlocked:
+        def mutate(self):
+            import time
+
+            time.sleep(0.002)
+
+    obj = Unlocked()
+    det = RaceDetector()
+    det.guard(obj, ["mutate"])
+    threads = [threading.Thread(target=lambda: [obj.mutate() for _ in range(20)])
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert det.violations, "detector missed guaranteed overlaps"
+
+
+def test_triedb_mutators_never_overlap_under_concurrent_load():
+    """The chain's locking discipline must serialize every TrieDatabase
+    mutation even with concurrent readers hammering state — the race
+    detector records any wall-clock overlap."""
+    chain, blocks = build_chain_and_blocks()
+    det = RaceDetector()
+    det.guard(chain.state_database.triedb,
+              ["update", "commit", "dereference", "cap", "_insert"])
+
+    stop = threading.Event()
+    read_errors = []
+
+    def reader():
+        rng = random.Random(1)
+        while not stop.is_set():
+            try:
+                st = chain.state()
+                st.get_balance(ADDR)
+                chain.get_block_by_number(
+                    rng.randrange(0, chain.current_block.number + 1))
+            except Exception as e:  # noqa: BLE001
+                read_errors.append(repr(e))
+
+    readers = [threading.Thread(target=reader) for _ in range(3)]
+    for t in readers:
+        t.start()
+    try:
+        for b in blocks:
+            chain.insert_block(b)
+            chain.accept(b)
+        chain.drain_acceptor_queue()
+    finally:
+        stop.set()
+        for t in readers:
+            t.join()
+    assert det.violations == [], det.violations[:5]
+    assert not read_errors, read_errors[:3]
+    chain.stop()
+
+
+def test_acceptor_drains_in_enqueue_order():
+    """The async acceptor is a single consumer: side effects must fire in
+    EXACT accept order regardless of queue depth (deterministic drain —
+    the reference's acceptor-queue contract, blockchain.go:1034)."""
+    chain, blocks = build_chain_and_blocks(16)
+    order = []
+    orig = chain.trie_writer.accept_trie
+
+    def spy(blk):
+        order.append(blk.number)
+        return orig(blk)
+
+    chain.trie_writer.accept_trie = spy
+    for b in blocks:
+        chain.insert_block(b)
+    # enqueue all accepts before the drain can keep up, twice interleaved
+    for b in blocks:
+        chain.accept(b)
+    chain.drain_acceptor_queue()
+    assert order == [b.number for b in blocks]
+    chain.stop()
+
+
+def test_txpool_concurrent_adds_lose_nothing():
+    """Compound-op race stress: concurrent adds for distinct senders must
+    neither lose nor duplicate transactions."""
+    n_senders, per_sender = 12, 8
+    keys = [bytes([i + 1]) * 32 for i in range(n_senders)]
+    addrs = [priv_to_address(k) for k in keys]
+    diskdb = MemoryDB()
+    genesis = Genesis(
+        config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+        alloc={a: GenesisAccount(balance=10**21) for a in addrs},
+    )
+    chain2 = BlockChain(
+        diskdb, CacheConfig(pruning=True), params.TEST_CHAIN_CONFIG,
+        genesis, new_dummy_engine(),
+        state_database=Database(TrieDatabase(diskdb)),
+    )
+    from coreth_tpu.core.txpool import TxPool, TxPoolConfig
+
+    pool = TxPool(TxPoolConfig(), params.TEST_CHAIN_CONFIG, chain2)
+    bf = params.APRICOT_PHASE3_INITIAL_BASE_FEE
+
+    add_errors = []
+
+    def add_all(idx):
+        for nonce in range(per_sender):
+            tx = Transaction(
+                type=2, chain_id=43112, nonce=nonce, max_fee=bf * 2,
+                max_priority_fee=1, gas=21000,
+                to=(0xE000 + idx).to_bytes(20, "big"), value=1,
+            )
+            try:
+                pool.add(SIGNER.sign(tx, keys[idx]))
+            except Exception as e:  # noqa: BLE001
+                add_errors.append(f"sender {idx} nonce {nonce}: {e!r}")
+
+    threads = [threading.Thread(target=add_all, args=(i,))
+               for i in range(n_senders)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not add_errors, add_errors[:3]
+
+    pending = pool.pending_txs()
+    total = sum(len(txs) for txs in pending.values())
+    assert total == n_senders * per_sender, (
+        f"lost transactions: {total} != {n_senders * per_sender}"
+    )
+    for addr, txs in pending.items():
+        nonces = [t.nonce for t in txs]
+        assert nonces == sorted(nonces) == list(range(len(txs)))
+    chain2.stop()
