@@ -191,7 +191,9 @@ def main():
     kernel_env = os.environ.get("CORETH_TPU_BENCH_KERNEL", "")  # "", xla, pallas
 
     # ------------------------------------------------ host-only phase first
-    from coreth_tpu.native.mpt import plan_commit
+    import numpy as np
+
+    from coreth_tpu.native.mpt import load, plan_commit
 
     workloads = {}
     for name, n in (("small", n_small), ("big", n_big)):
@@ -199,6 +201,9 @@ def main():
         t0 = time.perf_counter()
         plan = plan_commit(keys, vals, off)
         plan_s = time.perf_counter() - t0
+        phases = np.zeros(3)
+        load().mpt_plan_last_timings(phases)
+        REPORT[f"{name}_plan_phases_ms"] = [round(x * 1e3, 1) for x in phases]
         cpu_s, cpu_root = best_of(
             lambda k=keys, v=vals, o=off: plan_commit(k, v, o).execute_cpu(
                 threads=cpu_threads

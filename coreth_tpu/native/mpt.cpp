@@ -22,9 +22,11 @@
 //
 // Build: g++ -O3 -march=native -shared -fPIC -o libmpt.so mpt.cpp -lpthread
 
+#include <chrono>
 #include <cstdint>
 #include <cstring>
 #include <thread>
+#include <memory>
 #include <vector>
 #include <array>
 #include <algorithm>
@@ -32,6 +34,16 @@
 namespace {
 
 constexpr int kRate = 136;
+
+// last-plan phase timings (seconds): [build, alloc, rows]; exported for
+// perf triage (mpt_plan_last_timings; bench.py reports them)
+thread_local double g_timings[3];
+
+inline double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
 
 constexpr uint64_t kRC[24] = {
     0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808aULL,
@@ -105,10 +117,16 @@ struct Node {
 };
 
 struct Plan {
-  // inputs (borrowed views copied where needed)
-  std::vector<uint8_t> keys;     // n * 32
-  std::vector<uint8_t> vals;     // concatenated
-  std::vector<uint64_t> val_off; // n + 1
+  // inputs: BORROWED pointers when the caller guarantees lifetime
+  // (mpt_plan_borrowed — the ctypes wrapper pins the numpy arrays on the
+  // CommitPlan object), else copies owned by the vectors below. The
+  // borrow path saves a ~100 MB memcpy per 1M-leaf plan.
+  const uint8_t* keys_p = nullptr;
+  const uint8_t* vals_p = nullptr;
+  const uint64_t* val_off_p = nullptr;
+  std::vector<uint8_t> keys;     // owned copy (legacy entry point)
+  std::vector<uint8_t> vals;
+  std::vector<uint64_t> val_off;
   int64_t n = 0;
 
   std::vector<Node> nodes;
@@ -122,7 +140,11 @@ struct Plan {
     std::vector<int32_t> pl, po, pc;   // patch tables (lane, off, child row)
   };
   std::vector<Seg> segs;
-  std::vector<uint8_t> flat;     // padded segment messages
+  // flat: allocated UNINITIALIZED (new[] on POD) — rows are fully written
+  // by the writer incl. a memset of the padding tail; pad lanes hold
+  // garbage, which is harmless (their digests are never referenced)
+  std::unique_ptr<uint8_t[]> flat;
+  int64_t flat_size = 0;
   std::vector<int32_t> nblocks;  // per packed lane
   std::vector<int32_t> msg_len;  // real byte length per packed lane (pads: 0)
   int64_t total_lanes = 0;
@@ -202,7 +224,7 @@ struct Builder {
 
   // returns node id; fills enc_len/height
   int32_t build(int64_t lo, int64_t hi, int depth) {
-    const uint8_t* k0 = p.keys.data() + lo * 32;
+    const uint8_t* k0 = p.keys_p + lo * 32;
     if (hi - lo == 1) {
       Node nd{};
       nd.kind = 0;
@@ -210,19 +232,19 @@ struct Builder {
       nd.nib_end = 64;
       nd.key_idx = lo;
       nd.height = 0;
-      int vlen = (int)(p.val_off[lo + 1] - p.val_off[lo]);
+      int vlen = (int)(p.val_off_p[lo + 1] - p.val_off_p[lo]);
       uint8_t tmp[34];
       int clen = compact_len(64 - depth);
       write_compact(k0, depth, 64, true, tmp);
       int key_enc = bytes_enc_len(tmp, clen);
-      const uint8_t* v = p.vals.data() + p.val_off[lo];
+      const uint8_t* v = p.vals_p + p.val_off_p[lo];
       int payload = key_enc + bytes_enc_len(v, vlen);
       nd.enc_len = list_hdr_len(payload) + payload;
       p.nodes.push_back(nd);
       return (int32_t)p.nodes.size() - 1;
     }
     // longest common prefix from depth between first and last key
-    const uint8_t* kl = p.keys.data() + (hi - 1) * 32;
+    const uint8_t* kl = p.keys_p + (hi - 1) * 32;
     int lcp = depth;
     while (lcp < 64 && nibble(k0, lcp) == nibble(kl, lcp)) ++lcp;
     if (lcp > depth) {
@@ -254,9 +276,9 @@ struct Builder {
     int hmax = -1;
     int64_t s = lo;
     while (s < hi) {
-      int nb = nibble(p.keys.data() + s * 32, depth);
+      int nb = nibble(p.keys_p + s * 32, depth);
       int64_t e = s + 1;
-      while (e < hi && nibble(p.keys.data() + e * 32, depth) == nb) ++e;
+      while (e < hi && nibble(p.keys_p + e * 32, depth) == nb) ++e;
       int32_t child = build(s, e, depth + 1);
       nd.child[nb] = child;
       Node& c = p.nodes[child];
@@ -324,9 +346,9 @@ struct Writer {
     if (nd.kind == 0) {
       uint8_t tmp[34];
       int clen = compact_len(64 - nd.depth);
-      write_compact(p.keys.data() + nd.key_idx * 32, nd.depth, 64, true, tmp);
-      int vlen = (int)(p.val_off[nd.key_idx + 1] - p.val_off[nd.key_idx]);
-      const uint8_t* v = p.vals.data() + p.val_off[nd.key_idx];
+      write_compact(p.keys_p + nd.key_idx * 32, nd.depth, 64, true, tmp);
+      int vlen = (int)(p.val_off_p[nd.key_idx + 1] - p.val_off_p[nd.key_idx]);
+      const uint8_t* v = p.vals_p + p.val_off_p[nd.key_idx];
       int payload = bytes_enc_len(tmp, clen) + bytes_enc_len(v, vlen);
       out = write_list_hdr(payload, out);
       out = write_bytes(tmp, clen, out);
@@ -334,7 +356,7 @@ struct Writer {
     } else if (nd.kind == 1) {
       uint8_t tmp[34];
       int clen = compact_len(nd.nib_end - nd.depth);
-      write_compact(p.keys.data() + nd.key_idx * 32, nd.depth, nd.nib_end,
+      write_compact(p.keys_p + nd.key_idx * 32, nd.depth, nd.nib_end,
                     false, tmp);
       Node& c = p.nodes[nd.child[0]];
       int child_ref = c.enc_len < 32 ? c.enc_len : 33;
@@ -405,9 +427,13 @@ void layout(Plan& p) {
     i = j;
   }
   p.total_lanes = gstart;
-  p.flat.assign(byte_base, 0);
+  double t0 = now_s();
+  p.flat.reset(new uint8_t[byte_base]);
+  p.flat_size = byte_base;
   p.nblocks.assign(gstart, 1);
   p.msg_len.assign(gstart, 0);
+  g_timings[1] = now_s() - t0;
+  t0 = now_s();
 
   // write every hashed node's RLP into its padded row + collect patches;
   // rows are disjoint, so big segments fan out across hardware threads
@@ -427,13 +453,14 @@ void layout(Plan& p) {
       std::vector<std::pair<int32_t, int32_t>> patches;
       for (int lane = from; lane < to; ++lane) {
         int32_t id = seg.node_of_lane[lane];
-        uint8_t* row = p.flat.data() + seg.byte_base + (int64_t)lane * width;
+        uint8_t* row = p.flat.get() + seg.byte_base + (int64_t)lane * width;
         patches.clear();
         Writer w{p, patches, row};
         uint8_t* out = row;
         w.write_node(id, out);
         int len = (int)(out - row);
-        // keccak pad10*1
+        // flat is uninitialized: zero the padding tail, then pad10*1
+        std::memset(row + len, 0, width - len);
         row[len] ^= 0x01;
         row[width - 1] ^= 0x80;
         int32_t g = seg.gstart + lane;
@@ -469,6 +496,12 @@ void layout(Plan& p) {
         seg.pc.push_back(e[2]);
       }
     }
+    // pad/scratch lanes were never written: zero them so the exported
+    // buffer is deterministic and no heap bytes cross the FFI (<=4% of
+    // the buffer; the big win — skipping the full-buffer zero — stands)
+    if (seg.lanes > real)
+      std::memset(p.flat.get() + seg.byte_base + (int64_t)real * width, 0,
+                  (int64_t)(seg.lanes - real) * width);
     // pad patch table to pow2 >= 16; writes land in the scratch lane
     int np = (int)seg.pl.size();
     seg.n_patches = np ? pow2_at_least(np, 16) : 0;
@@ -481,32 +514,68 @@ void layout(Plan& p) {
     p.total_patches += seg.n_patches;
   }
   p.root_pos = p.nodes[p.root_id].lane;
+  g_timings[2] = now_s() - t0;
 }
 
 }  // namespace
 
 extern "C" {
 
+static Plan* plan_core(Plan* p, uint64_t n) {
+  p->n = (int64_t)n;
+  p->nodes.reserve((size_t)(n * 15 / 10) + 16);
+  double t0 = now_s();
+  Builder b{*p};
+  p->root_id = b.build(0, (int64_t)n, 0);
+  g_timings[0] = now_s() - t0;
+  layout(*p);
+  return p;
+}
+
+static bool keys_sorted(const uint8_t* keys, uint64_t n) {
+  for (uint64_t i = 1; i < n; ++i)
+    if (std::memcmp(keys + (i - 1) * 32, keys + i * 32, 32) >= 0) return false;
+  return true;
+}
+
 void* mpt_plan(const uint8_t* keys, const uint8_t* vals,
                const uint64_t* val_off, uint64_t n) {
   if (n == 0) return nullptr;  // empty trie: caller returns EMPTY_ROOT
   // reject duplicate keys: the build recursion assumes strictly-sorted
   // distinct keys (a duplicate would read past nibble 64)
-  for (uint64_t i = 1; i < n; ++i)
-    if (std::memcmp(keys + (i - 1) * 32, keys + i * 32, 32) >= 0) return nullptr;
+  if (!keys_sorted(keys, n)) return nullptr;
   Plan* p = new Plan();
-  p->n = (int64_t)n;
   p->keys.assign(keys, keys + n * 32);
   p->vals.assign(vals, vals + val_off[n]);
   p->val_off.assign(val_off, val_off + n + 1);
-  p->nodes.reserve((size_t)(n * 15 / 10) + 16);
-  Builder b{*p};
-  p->root_id = b.build(0, (int64_t)n, 0);
-  layout(*p);
-  return p;
+  p->keys_p = p->keys.data();
+  p->vals_p = p->vals.data();
+  p->val_off_p = p->val_off.data();
+  return plan_core(p, n);
 }
 
-uint64_t mpt_plan_flat_bytes(void* h) { return ((Plan*)h)->flat.size(); }
+// Zero-copy planning: the caller OWNS keys/vals/val_off and guarantees
+// they outlive the plan (the ctypes wrapper pins the numpy arrays on the
+// CommitPlan object). Saves the ~100 MB input memcpy at 1M leaves.
+void* mpt_plan_borrowed(const uint8_t* keys, const uint8_t* vals,
+                        const uint64_t* val_off, uint64_t n) {
+  if (n == 0) return nullptr;
+  if (!keys_sorted(keys, n)) return nullptr;
+  Plan* p = new Plan();
+  p->keys_p = keys;
+  p->vals_p = vals;
+  p->val_off_p = val_off;
+  return plan_core(p, n);
+}
+
+// phase timings of the LAST mpt_plan on this thread: [build, alloc, rows]
+void mpt_plan_last_timings(double* out3) {
+  out3[0] = g_timings[0];
+  out3[1] = g_timings[1];
+  out3[2] = g_timings[2];
+}
+
+uint64_t mpt_plan_flat_bytes(void* h) { return ((Plan*)h)->flat_size; }
 uint64_t mpt_plan_total_lanes(void* h) { return ((Plan*)h)->total_lanes; }
 uint64_t mpt_plan_num_segments(void* h) { return ((Plan*)h)->segs.size(); }
 uint64_t mpt_plan_total_patches(void* h) { return ((Plan*)h)->total_patches; }
@@ -519,7 +588,7 @@ void mpt_plan_export(void* h, uint8_t* flat_msgs, int32_t* nblocks,
                      int32_t* patch_lane, int32_t* patch_off,
                      int32_t* patch_child, int32_t* specs) {
   Plan* p = (Plan*)h;
-  std::memcpy(flat_msgs, p->flat.data(), p->flat.size());
+  std::memcpy(flat_msgs, p->flat.get(), p->flat_size);
   std::memcpy(nblocks, p->nblocks.data(), p->nblocks.size() * 4);
   int64_t pp = 0;
   for (size_t s = 0; s < p->segs.size(); ++s) {
@@ -558,13 +627,13 @@ void mpt_plan_execute_cpu(void* h, int threads, uint8_t* digests_out,
     // requires pristine templates whatever order the caller runs in.
     for (size_t k = 0; k < seg.pl.size(); ++k) {
       if (seg.pl[k] >= real) continue;  // scratch-lane padding
-      std::memcpy(p->flat.data() + seg.byte_base +
+      std::memcpy(p->flat.get() + seg.byte_base +
                       (int64_t)seg.pl[k] * width + seg.po[k],
                   dig + (int64_t)seg.pc[k] * 32, 32);
     }
     auto hash_range = [&](int from, int to) {
       for (int lane = from; lane < to; ++lane) {
-        keccak_padded(p->flat.data() + seg.byte_base + (int64_t)lane * width,
+        keccak_padded(p->flat.get() + seg.byte_base + (int64_t)lane * width,
                       seg.blocks, dig + ((int64_t)seg.gstart + lane) * 32);
       }
     };
@@ -584,7 +653,7 @@ void mpt_plan_execute_cpu(void* h, int threads, uint8_t* digests_out,
     // restore the zero digest slots (templates stay pristine)
     for (size_t k = 0; k < seg.pl.size(); ++k) {
       if (seg.pl[k] >= real) continue;
-      std::memset(p->flat.data() + seg.byte_base +
+      std::memset(p->flat.get() + seg.byte_base +
                       (int64_t)seg.pl[k] * width + seg.po[k],
                   0, 32);
     }
@@ -596,7 +665,7 @@ void mpt_plan_execute_cpu(void* h, int threads, uint8_t* digests_out,
 // IS the padded little-endian word stream keccak absorbs; exposing the
 // pointer lets the host wrap it as an array and ship it straight to the
 // device with no intermediate copy (the plan object owns the memory).
-const uint8_t* mpt_plan_flat_ptr(void* h) { return ((Plan*)h)->flat.data(); }
+const uint8_t* mpt_plan_flat_ptr(void* h) { return ((Plan*)h)->flat.get(); }
 
 // specs only: int32[num_segments, 4] = (blocks, lanes, gstart, n_patches)
 void mpt_plan_specs(void* h, int32_t* specs) {

@@ -49,6 +49,12 @@ def load():
             return None
         lib.mpt_plan.restype = ctypes.c_void_p
         lib.mpt_plan.argtypes = [_u8p, _u8p, _u64p, ctypes.c_uint64]
+        lib.mpt_plan_borrowed.restype = ctypes.c_void_p
+        lib.mpt_plan_borrowed.argtypes = [_u8p, _u8p, _u64p, ctypes.c_uint64]
+        lib.mpt_plan_last_timings.restype = None
+        lib.mpt_plan_last_timings.argtypes = [
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+        ]
         for name in ("mpt_plan_flat_bytes", "mpt_plan_total_lanes",
                      "mpt_plan_num_segments", "mpt_plan_total_patches",
                      "mpt_plan_num_hashed", "mpt_plan_num_nodes"):
@@ -231,15 +237,19 @@ def plan_commit(keys: np.ndarray, vals_blob: bytes,
     lib = load()
     if lib is None:
         raise RuntimeError("native mpt planner unavailable (no g++?)")
-    keys = np.ascontiguousarray(keys, dtype=np.uint8)
-    n = keys.shape[0]
+    keys = np.ascontiguousarray(keys, dtype=np.uint8).reshape(-1)
+    n = keys.shape[0] // 32
     if n == 0:
         raise ValueError("empty leaf set: commit of an empty trie is EMPTY_ROOT")
     blob = np.frombuffer(vals_blob, dtype=np.uint8)
     if blob.size == 0:
         blob = np.zeros(1, dtype=np.uint8)
-    h = lib.mpt_plan(keys.reshape(-1), np.ascontiguousarray(blob),
-                     np.ascontiguousarray(val_offsets, dtype=np.uint64), n)
+    blob = np.ascontiguousarray(blob)
+    off = np.ascontiguousarray(val_offsets, dtype=np.uint64)
+    # zero-copy: the native side reads the arrays ONLY during this call
+    # (Builder/Writer both run inside mpt_plan_borrowed), so no pinning
+    # beyond the call is needed — saves the ~100 MB input copy at 1M
+    h = lib.mpt_plan_borrowed(keys, blob, off, n)
     if not h:
         raise ValueError("mpt_plan rejected input (unsorted or duplicate keys)")
     return CommitPlan(h, lib)

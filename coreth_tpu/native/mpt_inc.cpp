@@ -28,6 +28,7 @@
 #include <cstdint>
 #include <cstring>
 #include <thread>
+#include <memory>
 #include <vector>
 #include <array>
 #include <algorithm>
@@ -177,9 +178,13 @@ struct Inc {
   int64_t n_leaves = 0;
   int64_t n_nodes = 0;
 
-  // active mini-plan
+  // active mini-plan. flat is allocated UNINITIALIZED — rows are fully
+  // written (incl. a padding-tail memset); pad lanes hold garbage whose
+  // digests nothing references
   std::vector<MiniSeg> segs;
-  std::vector<uint8_t> flat;
+  std::unique_ptr<uint8_t[]> flat;
+  int64_t flat_size = 0;
+  int64_t flat_cap = 0;
   std::vector<INode*> embedded_dirty;
   int64_t total_lanes = 0;
   int64_t total_patches = 0;
@@ -524,7 +529,7 @@ void mark_embedded_dirty(INode* n, std::vector<INode*>& out) {
 
 void build_plan(Inc& t) {
   t.segs.clear();
-  t.flat.clear();
+  t.flat_size = 0;
   t.embedded_dirty.clear();
   t.total_lanes = t.total_patches = 0;
   t.num_dirty_hashed = 0;
@@ -578,7 +583,11 @@ void build_plan(Inc& t) {
     i = j;
   }
   t.total_lanes = gstart;
-  t.flat.assign(byte_base, 0);
+  if (byte_base > t.flat_cap) {   // grow geometrically, reuse across commits
+    t.flat.reset(new uint8_t[byte_base * 3 / 2]);
+    t.flat_cap = byte_base * 3 / 2;
+  }
+  t.flat_size = byte_base;
 
   for (auto& seg : t.segs) {
     int width = seg.blocks * kRate;
@@ -586,12 +595,13 @@ void build_plan(Inc& t) {
     std::vector<std::pair<int32_t, INode*>> patches;
     for (int lane = 0; lane < real; ++lane) {
       INode* n = seg.node_of_lane[lane];
-      uint8_t* row = t.flat.data() + seg.byte_base + (int64_t)lane * width;
+      uint8_t* row = t.flat.get() + seg.byte_base + (int64_t)lane * width;
       patches.clear();
       MiniWriter w{patches, row};
       uint8_t* out = row;
       w.write_node(n, out);
       int len = (int)(out - row);
+      std::memset(row + len, 0, width - len);  // uninitialized tail
       row[len] ^= 0x01;
       row[width - 1] ^= 0x80;
       for (auto& pr : patches) {
@@ -600,6 +610,11 @@ void build_plan(Inc& t) {
         seg.pc.push_back(pr.second->lane);  // dirty children: lane assigned
       }
     }
+    // zero the never-written pad/scratch lanes (deterministic export,
+    // no heap/stale-commit bytes across the FFI)
+    if (seg.lanes > real)
+      std::memset(t.flat.get() + seg.byte_base + (int64_t)real * width, 0,
+                  (int64_t)(seg.lanes - real) * width);
     int np = (int)seg.pl.size();
     seg.n_patches = np ? pow2_at_least(np, 16) : 0;
     int scratch = seg.lanes - 1;
@@ -667,14 +682,14 @@ uint64_t mpt_inc_plan(void* h) {
   return t->segs.size();
 }
 
-uint64_t mpt_inc_flat_bytes(void* h) { return ((Inc*)h)->flat.size(); }
+uint64_t mpt_inc_flat_bytes(void* h) { return ((Inc*)h)->flat_size; }
 
 uint64_t mpt_inc_num_nodes(void* h) { return ((Inc*)h)->n_nodes; }
 uint64_t mpt_inc_num_dirty(void* h) { return ((Inc*)h)->num_dirty_hashed; }
 uint64_t mpt_inc_total_lanes(void* h) { return ((Inc*)h)->total_lanes; }
 uint64_t mpt_inc_total_patches(void* h) { return ((Inc*)h)->total_patches; }
 int32_t mpt_inc_root_pos(void* h) { return ((Inc*)h)->root_pos; }
-const uint8_t* mpt_inc_flat_ptr(void* h) { return ((Inc*)h)->flat.data(); }
+const uint8_t* mpt_inc_flat_ptr(void* h) { return ((Inc*)h)->flat.get(); }
 
 void mpt_inc_specs(void* h, int32_t* specs) {
   Inc* t = (Inc*)h;
@@ -717,13 +732,13 @@ void mpt_inc_execute_cpu(void* h, int threads, uint8_t* out_root32) {
     int real = (int)seg.node_of_lane.size();
     for (size_t k = 0; k < seg.pl.size(); ++k) {
       if (seg.pc[k] == -2) continue;
-      std::memcpy(t->flat.data() + seg.byte_base +
+      std::memcpy(t->flat.get() + seg.byte_base +
                       (int64_t)seg.pl[k] * width + seg.po[k],
                   dig.data() + (int64_t)seg.pc[k] * 32, 32);
     }
     auto hash_range = [&](int from, int to) {
       for (int lane = from; lane < to; ++lane)
-        keccak_padded(t->flat.data() + seg.byte_base + (int64_t)lane * width,
+        keccak_padded(t->flat.get() + seg.byte_base + (int64_t)lane * width,
                       seg.blocks, dig.data() + ((int64_t)seg.gstart + lane) * 32);
     };
     if (threads > 1 && real >= 256) {
@@ -740,7 +755,7 @@ void mpt_inc_execute_cpu(void* h, int threads, uint8_t* out_root32) {
     // restore pristine zero holes so the device leg can reuse the buffer
     for (size_t k = 0; k < seg.pl.size(); ++k) {
       if (seg.pc[k] == -2) continue;
-      std::memset(t->flat.data() + seg.byte_base +
+      std::memset(t->flat.get() + seg.byte_base +
                       (int64_t)seg.pl[k] * width + seg.po[k],
                   0, 32);
     }
