@@ -25,6 +25,7 @@
 #include <chrono>
 #include <cstdint>
 #include <cstring>
+#include <mutex>
 #include <thread>
 #include <memory>
 #include <vector>
@@ -38,6 +39,43 @@ constexpr int kRate = 136;
 // last-plan phase timings (seconds): [build, alloc, rows]; exported for
 // perf triage (mpt_plan_last_timings; bench.py reports them)
 thread_local double g_timings[3];
+
+// single-slot buffer pool: repeated plans of similar size (the chain's
+// per-block commits, bench repeats) reuse warm pages instead of paying
+// kernel zero-fill + fault on every 100s-of-MB allocation
+std::mutex g_pool_mu;
+uint8_t* g_pool_buf = nullptr;
+int64_t g_pool_cap = 0;
+
+// returns the buffer AND its true capacity (a pooled buffer's real
+// allocation, or the fresh over-allocation) — the caller must hand the
+// same cap back to pool_release, or the pool would overstate capacity
+// and later hand out undersized buffers
+uint8_t* pool_acquire(int64_t size, int64_t* cap_out) {
+  {
+    std::lock_guard<std::mutex> g(g_pool_mu);
+    if (g_pool_buf && g_pool_cap >= size) {
+      uint8_t* b = g_pool_buf;
+      *cap_out = g_pool_cap;
+      g_pool_buf = nullptr;
+      return b;
+    }
+  }
+  *cap_out = size + size / 4;
+  return new uint8_t[(size_t)(size + size / 4)];
+}
+
+void pool_release(uint8_t* buf, int64_t cap) {
+  if (!buf) return;
+  std::lock_guard<std::mutex> g(g_pool_mu);
+  if (!g_pool_buf || cap > g_pool_cap) {
+    delete[] g_pool_buf;
+    g_pool_buf = buf;
+    g_pool_cap = cap;
+  } else {
+    delete[] buf;
+  }
+}
 
 inline double now_s() {
   return std::chrono::duration<double>(
@@ -104,6 +142,22 @@ inline int nibble(const uint8_t* key32, int i) {
   return (i & 1) ? (b & 0xf) : (b >> 4);
 }
 
+// longest common nibble prefix of two 32-byte keys, starting at nibble
+// `from`: byte-wise scan (2 nibbles per compare) with odd-edge fixups
+inline int lcp_nibbles(const uint8_t* a, const uint8_t* b, int from) {
+  int i = from;
+  if (i & 1) {
+    if (nibble(a, i) != nibble(b, i)) return i;
+    ++i;
+  }
+  int byte = i >> 1;
+  while (byte < 32 && a[byte] == b[byte]) ++byte;
+  i = byte * 2;
+  if (i >= 64) return 64;
+  if (nibble(a, i) == nibble(b, i)) ++i;
+  return i;
+}
+
 struct Node {
   // kind: 0 leaf, 1 extension, 2 branch
   uint8_t kind;
@@ -140,11 +194,16 @@ struct Plan {
     std::vector<int32_t> pl, po, pc;   // patch tables (lane, off, child row)
   };
   std::vector<Seg> segs;
-  // flat: allocated UNINITIALIZED (new[] on POD) — rows are fully written
-  // by the writer incl. a memset of the padding tail; pad lanes hold
-  // garbage, which is harmless (their digests are never referenced)
-  std::unique_ptr<uint8_t[]> flat;
+  // flat: UNINITIALIZED pool buffer — rows are fully written by the
+  // writer (incl. padding-tail + pad-lane memsets); returned to the pool
+  // on destruction so repeated plans reuse warm pages
+  uint8_t* flat = nullptr;
   int64_t flat_size = 0;
+  int64_t flat_cap = 0;
+  Plan() = default;
+  Plan(const Plan&) = delete;             // manual buffer ownership:
+  Plan& operator=(const Plan&) = delete;  // copies would double-release
+  ~Plan() { pool_release(flat, flat_cap); }
   std::vector<int32_t> nblocks;  // per packed lane
   std::vector<int32_t> msg_len;  // real byte length per packed lane (pads: 0)
   int64_t total_lanes = 0;
@@ -245,8 +304,7 @@ struct Builder {
     }
     // longest common prefix from depth between first and last key
     const uint8_t* kl = p.keys_p + (hi - 1) * 32;
-    int lcp = depth;
-    while (lcp < 64 && nibble(k0, lcp) == nibble(kl, lcp)) ++lcp;
+    int lcp = lcp_nibbles(k0, kl, depth);
     if (lcp > depth) {
       int32_t child = build(lo, hi, lcp);
       Node nd{};
@@ -387,19 +445,33 @@ struct Writer {
 };
 
 void layout(Plan& p) {
-  // bucket hashed nodes by (level, blocks)
-  std::vector<std::pair<SegKey, int32_t>> entries;  // key -> node id
+  // bucket hashed nodes by (level, blocks) — counting sort over the tiny
+  // key space (height <= 64, blocks small) instead of a comparison sort
+  // of ~1.4M entries (~100 ms at the 1M-leaf scale)
+  std::vector<std::pair<SegKey, int32_t>> entries;
   entries.reserve(p.nodes.size());
+  int max_h = 0, max_b = 1;
   for (int32_t id = 0; id < (int32_t)p.nodes.size(); ++id) {
     Node& nd = p.nodes[id];
     bool hashed = nd.enc_len >= 32 || id == p.root_id;
     nd.lane = -1;
     if (!hashed) continue;
-    int blocks = nd.enc_len / kRate + 1;
+    int blocks = nd.enc_len / kRate + 1;  // unbounded: giant values legal
     entries.push_back({{nd.height, blocks}, id});
+    max_h = std::max(max_h, (int)nd.height);
+    max_b = std::max(max_b, blocks);
   }
-  std::stable_sort(entries.begin(), entries.end(),
-                   [](const auto& a, const auto& b) { return a.first < b.first; });
+  {
+    const int nb = max_b + 1;
+    std::vector<int64_t> counts((size_t)(max_h + 1) * nb + 1, 0);
+    for (auto& e : entries)
+      ++counts[(size_t)e.first.level * nb + e.first.blocks + 1];
+    for (size_t i = 1; i < counts.size(); ++i) counts[i] += counts[i - 1];
+    std::vector<std::pair<SegKey, int32_t>> sorted(entries.size());
+    for (auto& e : entries)
+      sorted[counts[(size_t)e.first.level * nb + e.first.blocks]++] = e;
+    entries.swap(sorted);
+  }
   p.num_hashed = (int64_t)entries.size();
 
   int64_t byte_base = 0;
@@ -428,7 +500,7 @@ void layout(Plan& p) {
   }
   p.total_lanes = gstart;
   double t0 = now_s();
-  p.flat.reset(new uint8_t[byte_base]);
+  p.flat = pool_acquire(byte_base, &p.flat_cap);
   p.flat_size = byte_base;
   p.nblocks.assign(gstart, 1);
   p.msg_len.assign(gstart, 0);
@@ -453,7 +525,7 @@ void layout(Plan& p) {
       std::vector<std::pair<int32_t, int32_t>> patches;
       for (int lane = from; lane < to; ++lane) {
         int32_t id = seg.node_of_lane[lane];
-        uint8_t* row = p.flat.get() + seg.byte_base + (int64_t)lane * width;
+        uint8_t* row = p.flat + seg.byte_base + (int64_t)lane * width;
         patches.clear();
         Writer w{p, patches, row};
         uint8_t* out = row;
@@ -500,7 +572,7 @@ void layout(Plan& p) {
     // buffer is deterministic and no heap bytes cross the FFI (<=4% of
     // the buffer; the big win — skipping the full-buffer zero — stands)
     if (seg.lanes > real)
-      std::memset(p.flat.get() + seg.byte_base + (int64_t)real * width, 0,
+      std::memset(p.flat + seg.byte_base + (int64_t)real * width, 0,
                   (int64_t)(seg.lanes - real) * width);
     // pad patch table to pow2 >= 16; writes land in the scratch lane
     int np = (int)seg.pl.size();
@@ -588,7 +660,7 @@ void mpt_plan_export(void* h, uint8_t* flat_msgs, int32_t* nblocks,
                      int32_t* patch_lane, int32_t* patch_off,
                      int32_t* patch_child, int32_t* specs) {
   Plan* p = (Plan*)h;
-  std::memcpy(flat_msgs, p->flat.get(), p->flat_size);
+  std::memcpy(flat_msgs, p->flat, p->flat_size);
   std::memcpy(nblocks, p->nblocks.data(), p->nblocks.size() * 4);
   int64_t pp = 0;
   for (size_t s = 0; s < p->segs.size(); ++s) {
@@ -627,13 +699,13 @@ void mpt_plan_execute_cpu(void* h, int threads, uint8_t* digests_out,
     // requires pristine templates whatever order the caller runs in.
     for (size_t k = 0; k < seg.pl.size(); ++k) {
       if (seg.pl[k] >= real) continue;  // scratch-lane padding
-      std::memcpy(p->flat.get() + seg.byte_base +
+      std::memcpy(p->flat + seg.byte_base +
                       (int64_t)seg.pl[k] * width + seg.po[k],
                   dig + (int64_t)seg.pc[k] * 32, 32);
     }
     auto hash_range = [&](int from, int to) {
       for (int lane = from; lane < to; ++lane) {
-        keccak_padded(p->flat.get() + seg.byte_base + (int64_t)lane * width,
+        keccak_padded(p->flat + seg.byte_base + (int64_t)lane * width,
                       seg.blocks, dig + ((int64_t)seg.gstart + lane) * 32);
       }
     };
@@ -653,7 +725,7 @@ void mpt_plan_execute_cpu(void* h, int threads, uint8_t* digests_out,
     // restore the zero digest slots (templates stay pristine)
     for (size_t k = 0; k < seg.pl.size(); ++k) {
       if (seg.pl[k] >= real) continue;
-      std::memset(p->flat.get() + seg.byte_base +
+      std::memset(p->flat + seg.byte_base +
                       (int64_t)seg.pl[k] * width + seg.po[k],
                   0, 32);
     }
@@ -665,7 +737,7 @@ void mpt_plan_execute_cpu(void* h, int threads, uint8_t* digests_out,
 // IS the padded little-endian word stream keccak absorbs; exposing the
 // pointer lets the host wrap it as an array and ship it straight to the
 // device with no intermediate copy (the plan object owns the memory).
-const uint8_t* mpt_plan_flat_ptr(void* h) { return ((Plan*)h)->flat.get(); }
+const uint8_t* mpt_plan_flat_ptr(void* h) { return ((Plan*)h)->flat; }
 
 // specs only: int32[num_segments, 4] = (blocks, lanes, gstart, n_patches)
 void mpt_plan_specs(void* h, int32_t* specs) {

@@ -169,3 +169,40 @@ class TestPlannedU32Executor:
         root_cpu = plan.execute_cpu()
         assert plan.execute_planned() == root_cpu
         assert plan.execute_cpu() == root_cpu  # and back again
+
+
+def test_pool_reuse_growing_sizes():
+    """Buffer-pool regression: plans of growing size through the pool must
+    never hand out an undersized buffer (review r3: capacity accounting)."""
+    import random
+
+    from coreth_tpu.native.mpt import plan_from_items
+
+    rng = random.Random(55)
+    roots = []
+    for n in (500, 900, 1400, 2000, 700):
+        items = [(rng.randbytes(32), rng.randbytes(60)) for _ in range(n)]
+        p = plan_from_items(items)
+        roots.append(p.execute_cpu())
+        del p  # releases into the pool for the next (bigger) plan
+    assert len(set(roots)) == len(roots)
+
+
+def test_giant_value_many_blocks():
+    """A leaf value far beyond 64 keccak blocks must still hash exactly
+    (review r3: no block-count clamp)."""
+    import random
+
+    from coreth_tpu.native.mpt import plan_from_items
+    from coreth_tpu.trie.hasher import Hasher
+    from coreth_tpu.trie.trie import Trie
+
+    rng = random.Random(56)
+    items = [(rng.randbytes(32), rng.randbytes(60)) for _ in range(50)]
+    items.append((rng.randbytes(32), rng.randbytes(20_000)))
+    p = plan_from_items(items)
+    t = Trie()
+    for k, v in dict(items).items():
+        t.update(k, v)
+    h, _ = Hasher().hash(t.root, True)
+    assert p.execute_cpu() == bytes(h)
