@@ -461,9 +461,11 @@ void layout(Plan& p) {
     max_h = std::max(max_h, (int)nd.height);
     max_b = std::max(max_b, blocks);
   }
-  {
+  const size_t key_space = (size_t)(max_h + 1) * (max_b + 1);
+  if (key_space <= entries.size() / 4 + 1024) {
+    // dense key space: O(n) counting sort (stable, same order as SegKey<)
     const int nb = max_b + 1;
-    std::vector<int64_t> counts((size_t)(max_h + 1) * nb + 1, 0);
+    std::vector<int64_t> counts(key_space + 1, 0);
     for (auto& e : entries)
       ++counts[(size_t)e.first.level * nb + e.first.blocks + 1];
     for (size_t i = 1; i < counts.size(); ++i) counts[i] += counts[i - 1];
@@ -471,6 +473,11 @@ void layout(Plan& p) {
     for (auto& e : entries)
       sorted[counts[(size_t)e.first.level * nb + e.first.blocks]++] = e;
     entries.swap(sorted);
+  } else {
+    // sparse (e.g. one giant value -> huge max_b): a counting table would
+    // dwarf the entry list; comparison sort is fine at these sizes
+    std::stable_sort(entries.begin(), entries.end(),
+                     [](const auto& a, const auto& b) { return a.first < b.first; });
   }
   p.num_hashed = (int64_t)entries.size();
 

@@ -1,0 +1,75 @@
+"""Generate GeneralStateTests-format fixtures from the semantic opcode
+corpus (opcode_vectors.py).
+
+    python tests/gen_fixtures.py     # rewrites fixtures/generated_state_tests.json
+
+Two validation layers on the same vectors:
+  * test_opcode_conformance.py asserts SEMANTIC expectations (independent
+    yellow-paper model) — catches wrong implementations;
+  * the generated fixtures freeze post-state ROOTS + log hashes in the
+    reference's state-test format (tests/state_test_util.go shape) —
+    catches consensus-visible drift in the EVM, state transition, trie,
+    or fork lattice with exact (test, fork) coordinates.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SENDER_KEY = "0x" + "45" * 32
+CONTRACT = "0x" + "cc" * 20
+GENERATED = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "fixtures", "generated_state_tests.json")
+FORK_NAMES = ["Istanbul", "Cortina"]
+
+
+def build_suite():
+    from opcode_vectors import build_vectors
+    from state_test_util import run_case
+
+    suite = {}
+    for name, code, calldata, _expected in build_vectors():
+        case = {
+            "env": {
+                "currentNumber": "0x7",
+                "currentTimestamp": "0x7",
+                "currentGasLimit": "0x989680",
+                "currentBaseFee": "0x34630b8a00",
+            },
+            "pre": {
+                "0xe0da1edcea030875cd0f199d96eb70f6ab78faf2": {
+                    "balance": "0x152d02c7e14af6800000", "nonce": "0x0",
+                },
+                CONTRACT: {"balance": "0x0", "code": "0x" + code.hex()},
+            },
+            "transaction": {
+                "type": "0x2",
+                "nonce": "0x0",
+                "gasLimit": "0x7a1200",
+                "maxFeePerGas": "0x68c6171400",
+                "maxPriorityFeePerGas": "0x00",
+                "to": CONTRACT,
+                "value": "0x0",
+                "data": "0x" + calldata.hex(),
+                "secretKey": SENDER_KEY,
+            },
+            "post": {},
+        }
+        for fork in FORK_NAMES:
+            case["post"][fork] = run_case(case, fork)
+        suite[f"gen_{name}"] = case
+    return suite
+
+
+def main():
+    suite = build_suite()
+    with open(GENERATED, "w") as f:
+        json.dump(suite, f, indent=1, sort_keys=True)
+    print(f"wrote {len(suite)} fixtures -> {GENERATED}")
+
+
+if __name__ == "__main__":
+    main()

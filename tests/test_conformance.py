@@ -35,6 +35,18 @@ def test_fixture_file_roots_and_logs(fixture):
     assert not failures, "\n".join(failures)
 
 
+def test_generated_corpus_depth():
+    """The generated corpus (tests/gen_fixtures.py over the semantic
+    opcode vectors) must stay at GeneralStateTests-scale depth."""
+    import json
+
+    path = os.path.join(FIXTURE_DIR, "generated_state_tests.json")
+    suite = json.load(open(path))
+    assert len(suite) >= 450, f"generated corpus shrank: {len(suite)}"
+    for case in suite.values():
+        assert set(case["post"]) == {"Istanbul", "Cortina"}
+
+
 def test_fixture_coverage_is_fork_sensitive():
     """The suite must actually exercise the fork lattice: at least one
     fixture diverges between Istanbul and an Apricot fork (else the

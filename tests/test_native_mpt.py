@@ -178,14 +178,20 @@ def test_pool_reuse_growing_sizes():
 
     from coreth_tpu.native.mpt import plan_from_items
 
+    from coreth_tpu.trie.hasher import Hasher
+    from coreth_tpu.trie.trie import Trie
+
     rng = random.Random(55)
-    roots = []
     for n in (500, 900, 1400, 2000, 700):
         items = [(rng.randbytes(32), rng.randbytes(60)) for _ in range(n)]
         p = plan_from_items(items)
-        roots.append(p.execute_cpu())
+        got = p.execute_cpu()
         del p  # releases into the pool for the next (bigger) plan
-    assert len(set(roots)) == len(roots)
+        t = Trie()
+        for k, v in dict(items).items():
+            t.update(k, v)
+        h, _ = Hasher().hash(t.root, True)
+        assert got == bytes(h), f"pool-reused plan produced a wrong root at n={n}"
 
 
 def test_giant_value_many_blocks():

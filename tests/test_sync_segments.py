@@ -118,9 +118,17 @@ def test_large_trie_syncs_segmented_and_bit_exact():
     assert counting.calls >= NUM_SEGMENTS
 
 
-def test_kill_and_resume_mid_segment():
+@pytest.mark.parametrize("backend", ["memory", "sqlite"])
+def test_kill_and_resume_mid_segment(backend, tmp_path):
     tdb, root = build_server_state(N_BIG)
-    client_db = MemoryDB()
+    if backend == "sqlite":
+        # the production disk backend: one WAL connection serialized under
+        # an RLock — four segment threads write batches concurrently
+        from coreth_tpu.ethdb.sqlitedb import SQLiteDB
+
+        client_db = SQLiteDB(str(tmp_path / "sync.db"), sync=False)
+    else:
+        client_db = MemoryDB()
 
     # first attempt dies after enough calls to have markered some ranges
     dying = CountingClient(make_client(tdb), die_after=2)
