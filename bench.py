@@ -51,9 +51,12 @@ REPORT = {
     "vs_baseline": 0.0,
 }
 
-_EMIT_LOCK = threading.Lock()
+# RLock: the signal handler runs on the main thread and may land while
+# the main thread is already inside emit() — a plain Lock would deadlock
+_EMIT_LOCK = threading.RLock()
 _EMITTED = False
 _ACTIVE_WATCHDOG: "PhaseWatchdog | None" = None
+_ACTIVE_PROBE: "subprocess.Popen | None" = None
 
 
 def emit(error: str | None = None, code: int | None = None):
@@ -132,20 +135,30 @@ def best_of(fn, repeats: int):
 
 
 def probe_subprocess(code: str, timeout: float) -> tuple[bool, str]:
-    """Run a device probe in a child process with a hard timeout."""
+    """Run a device probe in a child process with a hard timeout. The
+    child is tracked so the signal handler can kill it — an orphaned
+    probe on a wedged tunnel would hang forever holding the device."""
+    global _ACTIVE_PROBE
     try:
-        r = subprocess.run(
+        p = subprocess.Popen(
             [sys.executable, "-c", code],
             cwd=os.path.dirname(os.path.abspath(__file__)),
-            timeout=timeout,
-            capture_output=True,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
             text=True,
         )
-        return r.returncode == 0, (r.stdout + r.stderr)[-400:]
-    except subprocess.TimeoutExpired:
-        return False, f"probe timed out after {timeout:.0f}s"
+        _ACTIVE_PROBE = p
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.communicate()
+            return False, f"probe timed out after {timeout:.0f}s"
+        return p.returncode == 0, (out or "")[-400:]
     except Exception as e:  # noqa: BLE001
         return False, repr(e)
+    finally:
+        _ACTIVE_PROBE = None
 
 
 # the ambient sitecustomize forces JAX_PLATFORMS=axon at interpreter start
@@ -179,7 +192,33 @@ print("pallas parity ok")
 """
 
 
+def _install_signal_emitters():
+    """If the DRIVER times this process out (SIGTERM/SIGINT), land the
+    partial report before dying. Scope: CPython runs handlers between
+    bytecodes on the main thread, so this covers phases executing Python
+    (host legs, loops) but NOT a main thread stuck inside a native/device
+    call — the per-phase watchdog thread covers that case instead."""
+    import signal
+
+    def on_sig(signum, _frame):
+        p = _ACTIVE_PROBE
+        if p is not None:  # don't orphan a probe child onto the tunnel
+            try:
+                p.kill()
+            except OSError:
+                pass
+        emit(f"terminated by signal {signum} (partial results above are "
+             "real measurements)", code=3)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, on_sig)
+        except (ValueError, OSError):
+            pass  # non-main thread / restricted env
+
+
 def main():
+    _install_signal_emitters()
     t_start = time.monotonic()
     deadline = t_start + float(os.environ.get("CORETH_TPU_BENCH_DEADLINE", "1500"))
     n_big = int(os.environ.get("CORETH_TPU_BENCH_LEAVES", "200000"))
