@@ -21,10 +21,19 @@ CXX_FLAGS = ["-O3", "-march=native", "-shared", "-fPIC"]
 
 def build_and_load(src: str, lib_path: str,
                    timeout: int = 180) -> Optional[ctypes.CDLL]:
-    """Compile src -> lib_path (if stale) and dlopen it; None on failure."""
+    """Compile src -> lib_path (if stale) and dlopen it; None on failure.
+
+    Staleness considers the source AND every header in its directory
+    (mpt_common.h is shared by both planners — editing it alone must
+    rebuild them)."""
     try:
+        src_dir = os.path.dirname(os.path.abspath(src))
+        newest = os.path.getmtime(src)
+        for f in os.listdir(src_dir):
+            if f.endswith(".h"):
+                newest = max(newest, os.path.getmtime(os.path.join(src_dir, f)))
         stale = (not os.path.exists(lib_path)
-                 or os.path.getmtime(lib_path) < os.path.getmtime(src))
+                 or os.path.getmtime(lib_path) < newest)
     except OSError:
         stale = True
     if stale:

@@ -33,110 +33,27 @@
 #include <array>
 #include <algorithm>
 
+#include "mpt_common.h"
+
 namespace {
 
-constexpr int kRate = 136;
+using mptc::kRate;
+using mptc::keccak_padded;
+using mptc::bytes_enc_len;
+using mptc::list_hdr_len;
+using mptc::write_bytes;
+using mptc::write_list_hdr;
+using mptc::compact_len;
+using mptc::pow2_at_least;
+using mptc::round_lanes;
+using mptc::nibble;
 
 // ---- keccak-f[1600] (shared constants with mpt.cpp; the FIPS-202 spec) ----
 
-constexpr uint64_t kRC[24] = {
-    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808aULL,
-    0x8000000080008000ULL, 0x000000000000808bULL, 0x0000000080000001ULL,
-    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008aULL,
-    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000aULL,
-    0x000000008000808bULL, 0x800000000000008bULL, 0x8000000000008089ULL,
-    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
-    0x000000000000800aULL, 0x800000008000000aULL, 0x8000000080008081ULL,
-    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL};
-
-inline uint64_t rotl(uint64_t x, int n) {
-  return n == 0 ? x : (x << n) | (x >> (64 - n));
-}
-
-void keccakf(uint64_t a[25]) {
-  for (int round = 0; round < 24; ++round) {
-    uint64_t c[5], d[5];
-    for (int x = 0; x < 5; ++x)
-      c[x] = a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20];
-    for (int x = 0; x < 5; ++x)
-      d[x] = c[(x + 4) % 5] ^ rotl(c[(x + 1) % 5], 1);
-    for (int i = 0; i < 25; ++i) a[i] ^= d[i % 5];
-    static constexpr int kRot[25] = {0,  1,  62, 28, 27, 36, 44, 6,  55, 20, 3, 10, 43,
-                                     25, 39, 41, 45, 15, 21, 8,  18, 2,  61, 56, 14};
-    uint64_t b[25];
-    for (int x = 0; x < 5; ++x)
-      for (int y = 0; y < 5; ++y)
-        b[y + 5 * ((2 * x + 3 * y) % 5)] = rotl(a[x + 5 * y], kRot[x + 5 * y]);
-    for (int y = 0; y < 5; ++y)
-      for (int x = 0; x < 5; ++x)
-        a[x + 5 * y] = b[x + 5 * y] ^ (~b[(x + 1) % 5 + 5 * y] & b[(x + 2) % 5 + 5 * y]);
-    a[0] ^= kRC[round];
-  }
-}
-
-void keccak_padded(const uint8_t* row, int blocks, uint8_t* out) {
-  uint64_t st[25];
-  std::memset(st, 0, sizeof(st));
-  for (int b = 0; b < blocks; ++b) {
-    for (int i = 0; i < kRate / 8; ++i) {
-      uint64_t w;
-      std::memcpy(&w, row + b * kRate + 8 * i, 8);
-      st[i] ^= w;
-    }
-    keccakf(st);
-  }
-  std::memcpy(out, st, 32);
-}
-
 // ---- RLP helpers (shared shapes with mpt.cpp) -----------------------------
 
-inline int bytes_enc_len(const uint8_t* b, int n) {
-  if (n == 1 && b[0] < 0x80) return 1;
-  if (n < 56) return 1 + n;
-  int ll = 0;
-  for (int v = n; v; v >>= 8) ++ll;
-  return 1 + ll + n;
-}
-
-inline int list_hdr_len(int payload) {
-  if (payload < 56) return 1;
-  int ll = 0;
-  for (int v = payload; v; v >>= 8) ++ll;
-  return 1 + ll;
-}
-
-inline uint8_t* write_bytes(const uint8_t* b, int n, uint8_t* out) {
-  if (n == 1 && b[0] < 0x80) {
-    *out++ = b[0];
-  } else if (n < 56) {
-    *out++ = 0x80 + n;
-    std::memcpy(out, b, n);
-    out += n;
-  } else {
-    int ll = 0;
-    for (int v = n; v; v >>= 8) ++ll;
-    *out++ = 0xB7 + ll;
-    for (int i = ll - 1; i >= 0; --i) *out++ = (n >> (8 * i)) & 0xff;
-    std::memcpy(out, b, n);
-    out += n;
-  }
-  return out;
-}
-
-inline uint8_t* write_list_hdr(int payload, uint8_t* out) {
-  if (payload < 56) {
-    *out++ = 0xC0 + payload;
-  } else {
-    int ll = 0;
-    for (int v = payload; v; v >>= 8) ++ll;
-    *out++ = 0xF7 + ll;
-    for (int i = ll - 1; i >= 0; --i) *out++ = (payload >> (8 * i)) & 0xff;
-  }
-  return out;
-}
 
 // hex-prefix compact encoding of an unpacked nibble fragment
-inline int compact_len(int nnib) { return 1 + nnib / 2; }
 
 inline void write_compact_frag(const uint8_t* nib, int nnib, bool term,
                                uint8_t* out) {
@@ -204,11 +121,6 @@ struct Inc {
   }
 };
 
-inline int nib_at(const uint8_t* key32, int i) {
-  uint8_t b = key32[i >> 1];
-  return (i & 1) ? (b & 0xf) : (b >> 4);
-}
-
 // ---- bulk build from sorted leaves (initial state) ------------------------
 
 INode* build_range(Inc& t, const uint8_t* keys, const uint8_t* vals,
@@ -218,26 +130,26 @@ INode* build_range(Inc& t, const uint8_t* keys, const uint8_t* vals,
   if (hi - lo == 1) {
     INode* nd = new INode(0);
     nd->nnib = (uint8_t)(64 - depth);
-    for (int i = depth; i < 64; ++i) nd->frag[i - depth] = nib_at(k0, i);
+    for (int i = depth; i < 64; ++i) nd->frag[i - depth] = nibble(k0, i);
     nd->val.assign(vals + off[lo], vals + off[lo + 1]);
     return nd;
   }
   const uint8_t* kl = keys + (hi - 1) * 32;
   int lcp = depth;
-  while (lcp < 64 && nib_at(k0, lcp) == nib_at(kl, lcp)) ++lcp;
+  while (lcp < 64 && nibble(k0, lcp) == nibble(kl, lcp)) ++lcp;
   if (lcp > depth) {
     INode* nd = new INode(1);
     nd->nnib = (uint8_t)(lcp - depth);
-    for (int i = depth; i < lcp; ++i) nd->frag[i - depth] = nib_at(k0, i);
+    for (int i = depth; i < lcp; ++i) nd->frag[i - depth] = nibble(k0, i);
     nd->child[0] = build_range(t, keys, vals, off, lo, hi, lcp);
     return nd;
   }
   INode* nd = new INode(2);
   int64_t s = lo;
   while (s < hi) {
-    int nb = nib_at(keys + s * 32, depth);
+    int nb = nibble(keys + s * 32, depth);
     int64_t e = s + 1;
-    while (e < hi && nib_at(keys + e * 32, depth) == nb) ++e;
+    while (e < hi && nibble(keys + e * 32, depth) == nb) ++e;
     nd->child[nb] = build_range(t, keys, vals, off, s, e, depth + 1);
     s = e;
   }
@@ -255,7 +167,7 @@ struct Updater {
     if (!n) {
       INode* nd = new INode(0);
       nd->nnib = (uint8_t)(64 - pos);
-      for (int i = pos; i < 64; ++i) nd->frag[i - pos] = nib_at(key, i);
+      for (int i = pos; i < 64; ++i) nd->frag[i - pos] = nibble(key, i);
       nd->val.assign(v, v + vlen);
       ++t.n_nodes;
       changed = true;
@@ -264,7 +176,7 @@ struct Updater {
     if (n->kind == 0 || n->kind == 1) {
       int match = 0;
       while (match < n->nnib && pos + match < 64 &&
-             n->frag[match] == nib_at(key, pos + match))
+             n->frag[match] == nibble(key, pos + match))
         ++match;
       if (match == n->nnib) {
         if (n->kind == 0) {
@@ -305,14 +217,14 @@ struct Updater {
       }
       branch->child[old_nib] = old_tail;
       bool ch = false;
-      branch->child[nib_at(key, pos + match)] =
+      branch->child[nibble(key, pos + match)] =
           insert(nullptr, pos + match + 1, v, vlen, ch);
       INode* result = branch;
       if (match > 0) {
         INode* ext = new INode(1);
         ++t.n_nodes;
         ext->nnib = (uint8_t)match;
-        for (int i = 0; i < match; ++i) ext->frag[i] = nib_at(key, pos + i);
+        for (int i = 0; i < match; ++i) ext->frag[i] = nibble(key, pos + i);
         ext->child[0] = branch;
         result = ext;
       }
@@ -320,7 +232,7 @@ struct Updater {
       return result;
     }
     // branch
-    int nb = nib_at(key, pos);
+    int nb = nibble(key, pos);
     bool ch = false;
     n->child[nb] = insert(n->child[nb], pos + 1, v, vlen, ch);
     if (ch) n->dirty = true;
@@ -336,7 +248,7 @@ struct Updater {
     }
     if (n->kind == 0) {
       for (int i = 0; i < n->nnib; ++i)
-        if (n->frag[i] != nib_at(key, pos + i)) {
+        if (n->frag[i] != nibble(key, pos + i)) {
           changed = false;
           return n;
         }
@@ -347,7 +259,7 @@ struct Updater {
     }
     if (n->kind == 1) {
       for (int i = 0; i < n->nnib; ++i)
-        if (n->frag[i] != nib_at(key, pos + i)) {
+        if (n->frag[i] != nibble(key, pos + i)) {
           changed = false;
           return n;
         }
@@ -374,7 +286,7 @@ struct Updater {
       return n;  // c == nullptr cannot happen: branch delete collapses first
     }
     // branch
-    int nb = nib_at(key, pos);
+    int nb = nibble(key, pos);
     bool ch = false;
     n->child[nb] = erase(n->child[nb], pos + 1, ch);
     if (!ch) {
@@ -506,17 +418,6 @@ struct MiniWriter {
     }
   }
 };
-
-int pow2_at_least(int v, int floor_) {
-  int t = floor_;
-  while (t < v) t <<= 1;
-  return t;
-}
-
-int round_lanes(int v) {
-  if (v <= 8192) return pow2_at_least(v, 16);
-  return (v + 8191) / 8192 * 8192;
-}
 
 void mark_embedded_dirty(INode* n, std::vector<INode*>& out) {
   // dirty nodes with enc_len < 32 never get lanes; track to clear flags
