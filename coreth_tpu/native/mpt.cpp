@@ -168,8 +168,6 @@ struct Plan {
   int32_t root_pos = -1;
 };
 
-// RLP helpers -------------------------------------------------------------
-
 
 // hex-prefix compact encoding of key nibbles [from, to) with terminator flag
 // (/root/reference/trie/encoding.go hexToCompact semantics)

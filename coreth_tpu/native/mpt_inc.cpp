@@ -50,8 +50,6 @@ using mptc::nibble;
 
 // ---- keccak-f[1600] (shared constants with mpt.cpp; the FIPS-202 spec) ----
 
-// ---- RLP helpers (shared shapes with mpt.cpp) -----------------------------
-
 
 // hex-prefix compact encoding of an unpacked nibble fragment
 

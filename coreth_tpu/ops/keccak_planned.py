@@ -64,17 +64,18 @@ def _strip_contributions(dig: jax.Array, child_row: jax.Array,
     return lo | hi
 
 
-def _make_step(seg_impl):
+def _make_step(seg_impl, donate: bool = True):
     """Build the jitted per-segment step around one keccak kernel.
 
     Static args are SHAPES only (lanes, blocks, npatch, all bucketed) —
     the segment's offsets travel in the uploaded metadata row selected by
-    the traced scalar `seg_i`, so trie resizing never recompiles."""
+    the traced scalar `seg_i`, so trie resizing never recompiles.
+    donate=False builds a re-invokable variant (driver compile checks)."""
 
     @functools.partial(
         jax.jit,
         static_argnames=("lanes", "blocks", "npatch"),
-        donate_argnums=(0, 1),
+        donate_argnums=(0, 1) if donate else (),
     )
     def step(flat_words, dig, dstw_all, child_all, shift_all, meta, seg_i,
              *, lanes: int, blocks: int, npatch: int):
