@@ -173,3 +173,54 @@ def test_vm_config_accepts_planned():
     from coreth_tpu.vm.config import parse_config
 
     assert parse_config(b'{"device-hasher": "planned"}').device_hasher == "planned"
+
+
+def test_vm_level_planned_knob_end_to_end(monkeypatch):
+    """The operator-facing path: VMConfig(device_hasher="planned") flows
+    through initialize -> TrieDatabase -> Trie.hash, and the VM builds,
+    verifies, and accepts storage-writing blocks on the planned executor
+    with state identical to an "off" (CPU-recursive) VM."""
+    from coreth_tpu.ethdb import MemoryDB
+    from coreth_tpu.ops import device
+    from coreth_tpu.ops.keccak_jax import BatchedKeccak
+    from coreth_tpu.vm.shared_memory import Memory
+    from coreth_tpu.vm.vm import VM, SnowContext, VMConfig
+
+    # resolve the "device" keccak without a TPU: inject the batched fn
+    device._cached["fn"] = BatchedKeccak().digests
+    counter = PlannedRunCounter()
+    counter.install(monkeypatch)
+
+    roots = {}
+    try:
+        for mode in ("planned", "off"):
+            vm = VM()
+            genesis = Genesis(
+                config=params.TEST_CHAIN_CONFIG,
+                gas_limit=params.CORTINA_GAS_LIMIT,
+                alloc={a: GenesisAccount(balance=FUND) for a in ADDRS},
+            )
+            clock = [0]
+
+            def tick(vm=vm, clock=clock):
+                clock[0] = vm.blockchain.current_block.time + 2
+                return clock[0]
+
+            vm.initialize(
+                SnowContext(shared_memory=Memory()), MemoryDB(), genesis,
+                VMConfig(clock=tick, device_hasher=mode),
+            )
+            bf = params.APRICOT_PHASE3_INITIAL_BASE_FEE
+            for j, key in enumerate(KEYS):
+                vm.issue_tx(create_tx(0, key, bf, seed=j))
+            blk = vm.build_block()
+            blk.verify()
+            blk.accept()
+            vm.blockchain.drain_acceptor_queue()
+            roots[mode] = vm.blockchain.last_accepted.root
+            vm.shutdown()
+    finally:
+        device._cached.clear()
+
+    assert counter.runs > 0, "planned path never engaged through the VM"
+    assert roots["planned"] == roots["off"]
