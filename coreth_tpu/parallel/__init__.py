@@ -38,6 +38,38 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "batch") -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
+def make_mesh_2d(n_hosts: int, chips_per_host: int,
+                 axes=("host", "batch")) -> Mesh:
+    """2-D (host, chip) mesh — the multi-host deployment SHAPE.
+
+    The intent: the outer axis is the host boundary, so its collectives
+    ride DCN while the inner axis rides ICI — slow hops stay at the top
+    of the reduction tree (the scaling-book layout rule). Keccak lanes
+    are pure data parallelism, so the commit path shards lanes over BOTH
+    axes and the only cross-host traffic is the digest gather /
+    checksum psum.
+
+    Device ordering: mesh_utils.create_device_mesh arranges devices so
+    mesh rows align with the physical topology where the backend exposes
+    it; the naive reshape fallback is only correct on single-host /
+    virtual meshes (where this helper validates sharding LAYOUTS — on a
+    real multi-host slice, prefer mesh_utils.create_hybrid_device_mesh
+    with explicit per-host groupings)."""
+    want = n_hosts * chips_per_host
+    devs = jax.devices()
+    if len(devs) < want:
+        raise ValueError(f"need {want} devices, have {len(devs)}")
+    devs = devs[:want]
+    try:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_device_mesh(
+            (n_hosts, chips_per_host), devices=devs)
+    except Exception:  # virtual/CPU meshes: topology-agnostic reshape
+        arr = np.array(devs).reshape(n_hosts, chips_per_host)
+    return Mesh(arr, axes)
+
+
 class ShardedKeccak:
     """Batched keccak sharded across a device mesh (data-parallel lanes).
 
@@ -46,7 +78,9 @@ class ShardedKeccak:
     NamedSharding(P('batch')) so XLA splits the scan across chips over ICI.
     """
 
-    def __init__(self, mesh: Mesh, axis: str = "batch"):
+    def __init__(self, mesh: Mesh, axis="batch"):
+        # axis: str | tuple[str, ...] — a tuple shards the lane dim over
+        # several mesh axes (the 2-D host x chip layout)
         self.mesh = mesh
         self.axis = axis
         self._sharding = NamedSharding(mesh, P(axis))
@@ -86,7 +120,8 @@ class ShardedKeccak:
         return digest_words_to_bytes(out[:n])
 
 
-def commit_step(mesh: Mesh, axis: str = "batch"):
+def commit_step(mesh: Mesh, axis="batch"):
+    # axis: str | tuple[str, ...] (tuple = multi-axis lane sharding)
     """Jitted sharded state-commitment step for the multi-chip dry run.
 
     One "training step" of this framework is a level-batched hash drain:
