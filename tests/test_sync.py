@@ -105,12 +105,15 @@ class TestRangeProofs:
             )
 
 
-def build_server_vm(n_blocks=8, txs_per_block=5):
+def build_server_vm(n_blocks=8, txs_per_block=5, extra_alloc=None):
     mem = Memory()
     vm = VM()
+    alloc = {ADDR: GenesisAccount(balance=FUND)}
+    if extra_alloc:
+        alloc.update(extra_alloc)
     genesis = Genesis(
         config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
-        alloc={ADDR: GenesisAccount(balance=FUND)},
+        alloc=alloc,
     )
     clock = [0]
 

@@ -317,58 +317,35 @@ def test_two_vm_segmented_state_sync(monkeypatch):
     the server's account trie large enough that the production
     syncervm path (StateSyncClient -> StateSyncer defaults) takes the
     segmented route, and the client VM lands on the synced block with
-    the full state readable."""
+    the full state readable. Server/wiring come from test_sync.py's
+    shared helpers."""
+    from test_sync import ADDR, FUND, build_server_vm, wire_network
+
     from coreth_tpu import params
     from coreth_tpu.core.genesis import Genesis, GenesisAccount
-    from coreth_tpu.core.types import Signer, Transaction
-    from coreth_tpu.crypto.secp256k1 import priv_to_address
-    from coreth_tpu.sync.handlers import SyncHandler
     from coreth_tpu.vm.shared_memory import Memory
     from coreth_tpu.vm.syncervm import StateSyncClient, StateSyncServer
     from coreth_tpu.vm.vm import VM, SnowContext, VMConfig
 
-    KEY = b"\x22" * 32
-    ADDR = priv_to_address(KEY)
-    FUND = 10**21
     # > SEGMENT_THRESHOLD accounts straight from genesis (no block cost)
-    alloc = {ADDR: GenesisAccount(balance=FUND)}
-    for i in range(1, 2600):
-        alloc[i.to_bytes(20, "big")] = GenesisAccount(balance=10**12 + i)
-    genesis = Genesis(config=params.TEST_CHAIN_CONFIG,
-                      gas_limit=params.CORTINA_GAS_LIMIT, alloc=alloc)
-
-    server = VM()
-    clock = [0]
-
-    def tick():
-        clock[0] = server.blockchain.current_block.time + 2
-        return clock[0]
-
-    server.initialize(SnowContext(shared_memory=Memory()), MemoryDB(),
-                      genesis, VMConfig(clock=tick, commit_interval=4))
-    signer = Signer(43112)
-    for n in range(4):
-        tx = Transaction(type=2, chain_id=43112, nonce=n, max_fee=10**12,
-                         max_priority_fee=10**9, gas=21000,
-                         to=b"\x77" * 20, value=9)
-        server.issue_tx(signer.sign(tx, KEY))
-        blk = server.build_block()
-        blk.verify()
-        blk.accept()
-    server.blockchain.drain_acceptor_queue()
+    extra = {i.to_bytes(20, "big"): GenesisAccount(balance=10**12 + i)
+             for i in range(1, 2600)}
+    server, _mem = build_server_vm(n_blocks=4, txs_per_block=1,
+                                   extra_alloc=extra)
 
     sync_server = StateSyncServer(server.blockchain, syncable_interval=4)
     summary = sync_server.get_last_state_summary()
     assert summary is not None
 
+    # client shares the server's EXACT genesis (same block-hash chain)
+    client_genesis = Genesis(
+        config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+        alloc={ADDR: GenesisAccount(balance=FUND), **extra},
+    )
     client_vm = VM()
     client_vm.initialize(SnowContext(shared_memory=Memory()), MemoryDB(),
-                         genesis, VMConfig())
-    net = Network(self_id=b"client")
-    handler = SyncHandler(server.blockchain,
-                          server.state_database.triedb,
-                          server.blockchain.diskdb)
-    net.connect(b"server", lambda sender, req: handler.handle(sender, req))
+                         client_genesis, VMConfig())
+    net = wire_network(server)
 
     # spy: the production path must take the segmented route (the raw
     # request count can legitimately be tiny — segments already covered
@@ -386,9 +363,13 @@ def test_two_vm_segmented_state_sync(monkeypatch):
 
     assert client_vm.blockchain.last_accepted.hash() == summary.block_hash
     st = client_vm.blockchain.state()
-    assert st.get_balance(b"\x77" * 20) == 4 * 9
+    from test_sync import DEST
+
+    assert st.get_balance(DEST) == 4 * 1 * 3  # blocks x txs x value
     assert st.get_balance((1717).to_bytes(20, "big")) == 10**12 + 1717
     assert seg_calls.get("yes"), "segmented route never engaged"
+    # the sync actually crossed the wire (not served from local genesis)
+    assert counting.calls > 0 and counting.leaves >= 2600
     # no sync debris in the client db
     assert not list(client_vm.blockchain.diskdb.iterate(SYNC_SEGMENT_PREFIX))
     assert not list(client_vm.blockchain.diskdb.iterate(SYNC_LEAF_PREFIX))
