@@ -115,6 +115,7 @@ def build_server_vm(n_blocks=8, txs_per_block=5, extra_alloc=None):
         config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
         alloc=alloc,
     )
+    vm.test_genesis = genesis  # clients must share the EXACT genesis
     clock = [0]
 
     def tick():

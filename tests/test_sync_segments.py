@@ -319,10 +319,9 @@ def test_two_vm_segmented_state_sync(monkeypatch):
     segmented route, and the client VM lands on the synced block with
     the full state readable. Server/wiring come from test_sync.py's
     shared helpers."""
-    from test_sync import ADDR, FUND, build_server_vm, wire_network
+    from test_sync import build_server_vm, wire_network
 
-    from coreth_tpu import params
-    from coreth_tpu.core.genesis import Genesis, GenesisAccount
+    from coreth_tpu.core.genesis import GenesisAccount
     from coreth_tpu.vm.shared_memory import Memory
     from coreth_tpu.vm.syncervm import StateSyncClient, StateSyncServer
     from coreth_tpu.vm.vm import VM, SnowContext, VMConfig
@@ -337,14 +336,10 @@ def test_two_vm_segmented_state_sync(monkeypatch):
     summary = sync_server.get_last_state_summary()
     assert summary is not None
 
-    # client shares the server's EXACT genesis (same block-hash chain)
-    client_genesis = Genesis(
-        config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
-        alloc={ADDR: GenesisAccount(balance=FUND), **extra},
-    )
+    # client shares the server's EXACT genesis object (no drift possible)
     client_vm = VM()
     client_vm.initialize(SnowContext(shared_memory=Memory()), MemoryDB(),
-                         client_genesis, VMConfig())
+                         server.test_genesis, VMConfig())
     net = wire_network(server)
 
     # spy: the production path must take the segmented route (the raw
