@@ -1,0 +1,31 @@
+#!/bin/bash
+# Probe the axon TPU tunnel every 8 minutes; on first success, run the
+# early-bench (bench.py quick leg incl. Pallas parity) and write
+# BENCH_EARLY_r04.json. Appends one status line per probe to
+# tools/tunnel_probe.log so the round has a liveness record either way.
+#
+# Probe discipline per memory/axon-tunnel-operations: PYTHONPATH must
+# include /root/.axon_site; generous timeout (120s >> healthy first-op
+# ~1.6-40s) so we never kill a merely-slow device-attached process.
+cd /root/repo
+LOG=tools/tunnel_probe.log
+while true; do
+  ts=$(date -u +%H:%M:%S)
+  if timeout 120 env PYTHONPATH=/root/repo:/root/.axon_site python -c "
+import jax, jax.numpy as jnp
+(jnp.zeros(8)+1).block_until_ready()
+" >/dev/null 2>&1; then
+    echo "$ts ALIVE" >> "$LOG"
+    if [ ! -f BENCH_EARLY_r04.json ]; then
+      echo "$ts running early bench" >> "$LOG"
+      timeout 900 env PYTHONPATH=/root/repo:/root/.axon_site \
+        CORETH_TPU_BENCH_EARLY=1 python bench.py --early \
+        > BENCH_EARLY_r04.json 2>> "$LOG" \
+        && echo "$ts early bench done" >> "$LOG" \
+        || echo "$ts early bench FAILED" >> "$LOG"
+    fi
+  else
+    echo "$ts wedged (probe timeout/err)" >> "$LOG"
+  fi
+  sleep 480
+done
