@@ -325,6 +325,27 @@ def load_inc():
         lib.mpt_inc_execute_cpu.argtypes = [ctypes.c_void_p, ctypes.c_int, _u8p]
         lib.mpt_inc_absorb.restype = None
         lib.mpt_inc_absorb.argtypes = [ctypes.c_void_p, _u8p, _u8p]
+        lib.mpt_inc_plan_res.restype = ctypes.c_uint64
+        lib.mpt_inc_plan_res.argtypes = [ctypes.c_void_p]
+        lib.mpt_inc_res_meta.restype = None
+        lib.mpt_inc_res_meta.argtypes = [
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ]
+        lib.mpt_inc_res_specs.restype = None
+        lib.mpt_inc_res_specs.argtypes = [ctypes.c_void_p, _i32p]
+        lib.mpt_inc_res_cls_counts.restype = None
+        lib.mpt_inc_res_cls_counts.argtypes = [ctypes.c_void_p, _i32p]
+        lib.mpt_inc_res_fresh.restype = None
+        lib.mpt_inc_res_fresh.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, _u8p, _i32p,
+        ]
+        lib.mpt_inc_res_tables.restype = None
+        lib.mpt_inc_res_tables.argtypes = [
+            ctypes.c_void_p, _i32p, _i32p, _i32p, _i32p, _i32p, _i32p, _i32p,
+        ]
+        lib.mpt_inc_res_mark_clean.restype = None
+        lib.mpt_inc_res_mark_clean.argtypes = [ctypes.c_void_p]
         lib.mpt_inc_root.restype = None
         lib.mpt_inc_root.argtypes = [ctypes.c_void_p, _u8p]
         lib.mpt_inc_free.restype = None
@@ -414,6 +435,7 @@ class IncrementalTrie:
 
     def commit_cpu(self, threads: int = 1) -> bytes:
         """Incremental host commit; returns the 32-byte root."""
+        self._pin_mode("host")
         if self._lib.mpt_inc_plan(self._h) == 0:
             return self.root()
         out = np.empty(32, np.uint8)
@@ -423,6 +445,7 @@ class IncrementalTrie:
     def commit_device(self, planned=None) -> bytes:
         """Incremental device commit through ops/keccak_planned; h2d is
         O(dirty set), digests read back into the native cache."""
+        self._pin_mode("host")
         exported = self._export_plan()
         if exported is None:
             return self.root()
@@ -439,6 +462,108 @@ class IncrementalTrie:
             self._h, np.ascontiguousarray(dig8.reshape(-1)), out)
         return out.tobytes()
 
+    # ---- resident commits (deferred absorb + template residency) ----
+    #
+    # A trie is EITHER host-cached (commit_cpu/commit_device keep the
+    # digest cache on the host) OR device-resident (digests live only in
+    # the executor's store). Mixing modes would serve stale digests, so
+    # the first commit pins the mode.
+
+    def _check_mode(self, mode: str):
+        cur = getattr(self, "_mode", None)
+        if cur is not None and cur != mode:
+            raise RuntimeError(
+                f"trie is in {cur!r} commit mode; {mode!r} commits would "
+                "read a stale digest cache")
+
+    def _pin_mode(self, mode: str):
+        self._check_mode(mode)
+        self._mode = mode
+
+    def export_resident_plan(self):
+        """Plan the dirty subtree for a device-resident commit and export
+        the upload payload (ops/keccak_resident.py's input format).
+        Returns None when nothing is dirty."""
+        lib, h = self._lib, self._h
+        n_seg = int(lib.mpt_inc_plan_res(h))
+        if n_seg == (1 << 64) - 1:
+            raise ValueError("node RLP wider than the resident row limit")
+        if n_seg == 0:
+            return None
+        meta = np.empty(7, np.int64)
+        lib.mpt_inc_res_meta(h, meta)
+        total_lanes, total_patches = int(meta[0]), int(meta[1])
+        specs = np.empty((n_seg, 6), np.int32)
+        lib.mpt_inc_res_specs(h, specs.reshape(-1))
+        n_cls = int(meta[6])
+        cls_counts = np.empty((n_cls, 2), np.int32)
+        lib.mpt_inc_res_cls_counts(h, cls_counts.reshape(-1))
+        rowidx = np.empty(total_lanes, np.int32)
+        lane_slot = np.empty(total_lanes, np.int32)
+        dstw = np.empty(total_patches, np.int32)
+        digidx = np.empty(total_patches, np.int32)
+        storeidx = np.empty(total_patches, np.int32)
+        oldidx = np.empty(total_patches, np.int32)
+        shift = np.empty(total_patches, np.int32)
+        lib.mpt_inc_res_tables(
+            h, rowidx, lane_slot, dstw, digidx, storeidx, oldidx, shift)
+        fresh = {}
+        classes = {}
+        for cls in range(1, n_cls):
+            n_fresh, rows_needed = int(cls_counts[cls, 0]), int(
+                cls_counts[cls, 1])
+            if rows_needed > 1:
+                classes[cls] = (n_fresh, rows_needed)
+            if n_fresh == 0:
+                continue
+            width = cls * 136
+            rows = np.empty(n_fresh * width, np.uint8)
+            idx = np.empty(n_fresh, np.int32)
+            lib.mpt_inc_res_fresh(h, cls, rows, idx)
+            fresh[cls] = (rows.view(np.uint32).reshape(n_fresh, width // 4),
+                          idx)
+        return {
+            "specs": specs,
+            "classes": classes,
+            "fresh": fresh,
+            "rowidx": rowidx,
+            "lane_slot": lane_slot,
+            "dstw": dstw,
+            "digidx": digidx,
+            "storeidx": storeidx,
+            "oldidx": oldidx,
+            "shift": shift,
+            "total_lanes": total_lanes,
+            "store_slots": int(meta[2]),
+            "root_lane": int(meta[3]),
+            "num_dirty": int(meta[4]),
+            "fresh_bytes": int(meta[5]),
+        }
+
+    def commit_resident(self, executor):
+        """Device-resident commit: plan, ship fresh rows + patch tables,
+        dispatch, mark clean. Returns the LAZY uint32[8] root handle (use
+        executor.root_bytes(...) to synchronize) so callers can pipeline
+        the next commit's planning against this commit's device work."""
+        if self.num_nodes == 0:
+            # empty trie: nothing device-side to do, and the previous
+            # last_root (if any) is stale — the root is the constant
+            self._pin_mode("resident")
+            executor.bind(self)
+            empty = np.frombuffer(EMPTY_ROOT, np.uint8).view("<u4").copy()
+            executor.last_root = empty
+            return empty
+        self._check_mode("resident")
+        executor.check_binding(self)
+        export = self.export_resident_plan()  # may raise: mode not pinned yet
+        self._pin_mode("resident")
+        executor.bind(self)
+        if export is None:
+            return executor.last_root
+        root = executor.run(export)
+        self._lib.mpt_inc_res_mark_clean(self._h)
+        return root
+
     def dirty_stats(self):
         """(dirty hashed nodes, mini-plan bytes) of the CURRENT plan —
         call right after commit planning to size the transfer."""
@@ -448,6 +573,12 @@ class IncrementalTrie:
     def root(self) -> bytes:
         if self.num_nodes == 0:
             return EMPTY_ROOT
+        if getattr(self, "_mode", None) == "resident":
+            # resident commits never write the host digest cache; the
+            # root lives on the device (executor.last_root)
+            raise RuntimeError(
+                "trie is in resident mode: read the root from the "
+                "executor handle returned by commit_resident()")
         out = np.empty(32, np.uint8)
         self._lib.mpt_inc_root(self._h, out)
         return out.tobytes()

@@ -68,15 +68,26 @@ inline void write_compact_frag(const uint8_t* nib, int nnib, bool term,
 struct INode {
   uint8_t kind;     // 0 leaf, 1 ext, 2 branch
   bool dirty;
+  // resident mode: this node's device ROW bytes changed (not just a child
+  // digest) — set by the updater on any mutation of the node's own
+  // template (fragment/value/child-set/kind), by plan-time checks on
+  // embedded or kind-unstable children, and on creation
+  bool structural;
   uint8_t nnib;     // fragment length (leaf/ext)
+  uint8_t row_blocks;  // block class of the resident device row (0: none)
   int32_t enc_len;  // cached RLP length (valid when !dirty or after plan)
+  int32_t prev_enc;    // enc_len before this plan's recompute (res collect)
   int32_t lane;     // mini-plan lane (-1: embedded or clean)
+  int32_t slot;     // persistent device digest-store slot (-1: none)
+  int32_t row;      // persistent device arena row in class row_blocks
   uint8_t frag[64];
   uint8_t digest[32];
   std::vector<uint8_t> val;  // leaf payload
   INode* child[16];          // branch children; ext: child[0]
 
-  INode(uint8_t k) : kind(k), dirty(true), nnib(0), enc_len(0), lane(-1) {
+  INode(uint8_t k)
+      : kind(k), dirty(true), structural(true), nnib(0), row_blocks(0),
+        enc_len(-1), prev_enc(-1), lane(-1), slot(-1), row(-1) {
     std::memset(child, 0, sizeof(child));
   }
 };
@@ -88,10 +99,68 @@ struct MiniSeg {
   std::vector<int32_t> pl, po, pc;  // patch (lane, byte off, child lane)
 };
 
+// Resident-plan segment: a (dirty-height level, block-count) bucket whose
+// rows all live in the same device arena class.
+struct ResSeg {
+  int32_t blocks, lanes, gstart, n_patches, patch_off, lane_off;
+  std::vector<INode*> node_of_lane;
+  std::vector<uint8_t> fresh_of_lane;  // pass-1 upload decision per lane
+};
+
+constexpr int kMaxBlocks = 64;  // widest supported node row (8.7 KB RLP)
+
 struct Inc {
   INode* root = nullptr;
   int64_t n_leaves = 0;
   int64_t n_nodes = 0;
+
+  // ---- resident-commit state (device-side store/arena bookkeeping) ----
+  // slot 0 = zero sentinel ("no digest"), slot 1 = pad-lane scratch;
+  // arena row 0 per class = scratch. Both are device-side conventions the
+  // Python executor (ops/keccak_resident.py) mirrors.
+  int32_t next_slot = 2;
+  std::vector<int32_t> free_slots;
+  struct ResCls {
+    int32_t next_row = 1;
+    std::vector<int32_t> free_rows;
+    std::vector<uint8_t> fresh_rows;  // packed row bytes to upload
+    std::vector<int32_t> fresh_idx;   // target arena rows
+  };
+  std::vector<ResCls> rcls = std::vector<ResCls>(kMaxBlocks + 1);
+  std::vector<ResSeg> rsegs;
+  std::vector<int32_t> r_rowidx, r_lane_slot;
+  std::vector<int32_t> r_dstw, r_digidx, r_storeidx, r_oldidx, r_shift;
+  std::vector<INode*> r_embedded_dirty;
+  int32_t r_root_lane = -1;
+  int64_t r_total_lanes = 0, r_total_patches = 0, r_num_dirty = 0;
+  int64_t r_fresh_bytes = 0;  // h2d row payload this commit (diagnostics)
+
+  int32_t alloc_slot() {
+    if (!free_slots.empty()) {
+      int32_t s = free_slots.back();
+      free_slots.pop_back();
+      return s;
+    }
+    return next_slot++;
+  }
+
+  void release_device(INode* n) {
+    if (n->slot >= 0) {
+      free_slots.push_back(n->slot);
+      n->slot = -1;
+    }
+    if (n->row >= 0) {
+      rcls[n->row_blocks].free_rows.push_back(n->row);
+      n->row = -1;
+      n->row_blocks = 0;
+    }
+  }
+
+  // delete one node, returning its device resources to the free lists
+  void release(INode* n) {
+    release_device(n);
+    delete n;
+  }
 
   // active mini-plan. flat is allocated UNINITIALIZED — rows are fully
   // written (incl. a padding-tail memset); pad lanes hold garbage whose
@@ -185,11 +254,14 @@ struct Updater {
           }
           n->val.assign(v, v + vlen);
           n->dirty = true;
+          n->structural = true;  // row bytes = value bytes
           changed = true;
           return n;
         }
         bool ch = false;
+        INode* prev = n->child[0];
         n->child[0] = insert(n->child[0], pos + match, v, vlen, ch);
+        if (n->child[0] != prev) n->structural = true;
         if (ch) n->dirty = true;
         changed = ch;
         return n;
@@ -203,7 +275,7 @@ struct Updater {
       if (n->kind == 1 && match + 1 == n->nnib) {
         old_tail = n->child[0];  // ext fully consumed: child moves up CLEAN
         n->child[0] = nullptr;
-        delete n;
+        t.release(n);
         --t.n_nodes;
       } else {
         // shift fragment left; node keeps identity (and digest-dirtiness:
@@ -211,6 +283,7 @@ struct Updater {
         std::memmove(n->frag, n->frag + match + 1, n->nnib - match - 1);
         n->nnib = (uint8_t)(n->nnib - match - 1);
         n->dirty = true;
+        n->structural = true;
         old_tail = n;
       }
       branch->child[old_nib] = old_tail;
@@ -232,7 +305,9 @@ struct Updater {
     // branch
     int nb = nibble(key, pos);
     bool ch = false;
+    INode* prev = n->child[nb];
     n->child[nb] = insert(n->child[nb], pos + 1, v, vlen, ch);
+    if (n->child[nb] != prev) n->structural = true;
     if (ch) n->dirty = true;
     changed = ch;
     return n;
@@ -250,7 +325,7 @@ struct Updater {
           changed = false;
           return n;
         }
-      delete n;
+      t.release(n);
       --t.n_nodes;
       changed = true;
       return nullptr;
@@ -262,12 +337,14 @@ struct Updater {
           return n;
         }
       bool ch = false;
+      INode* prev = n->child[0];
       INode* c = erase(n->child[0], pos + n->nnib, ch);
       if (!ch) {
         changed = false;
         return n;
       }
       n->child[0] = c;
+      if (c != prev) n->structural = true;
       n->dirty = true;
       changed = true;
       if (c && (c->kind == 0 || c->kind == 1)) {
@@ -277,8 +354,9 @@ struct Updater {
         n->kind = c->kind;
         n->val = std::move(c->val);
         n->child[0] = c->child[0];
+        n->structural = true;
         c->child[0] = nullptr;
-        delete c;
+        t.release(c);
         --t.n_nodes;
       }
       return n;  // c == nullptr cannot happen: branch delete collapses first
@@ -286,11 +364,13 @@ struct Updater {
     // branch
     int nb = nibble(key, pos);
     bool ch = false;
+    INode* prev = n->child[nb];
     n->child[nb] = erase(n->child[nb], pos + 1, ch);
     if (!ch) {
       changed = false;
       return n;
     }
+    if (n->child[nb] != prev) n->structural = true;
     n->dirty = true;
     changed = true;
     int remain = -1, count = 0;
@@ -303,13 +383,14 @@ struct Updater {
     // collapse: single remaining child merges with its slot nibble
     INode* c = n->child[remain];
     n->child[remain] = nullptr;
-    delete n;
+    t.release(n);
     --t.n_nodes;
     if (c->kind == 0 || c->kind == 1) {
       std::memmove(c->frag + 1, c->frag, c->nnib);
       c->frag[0] = (uint8_t)remain;
       c->nnib = (uint8_t)(c->nnib + 1);
       c->dirty = true;
+      c->structural = true;
       return c;
     }
     INode* ext = new INode(1);
@@ -332,9 +413,19 @@ inline int child_ref_len(const INode* c) {
 // 0x20/0x3x, ext 0x00/0x1x) so it self-encodes
 inline int frag_enc_len(int clen) { return clen == 1 ? 1 : 1 + clen; }
 
-// post-order: recompute enc_len of dirty nodes, collect by dirty-height
+// post-order: recompute enc_len of dirty nodes, collect by dirty-height.
+// Shared by the mini-plan and the resident plan: it also saves prev_enc
+// and lifts embedded/ref-unstable dirty children into parent->structural
+// (both no-ops for the non-resident path, which ignores those fields).
 int collect(INode* n, std::vector<std::vector<INode*>>& levels) {
   if (!n || !n->dirty) return -1;
+  n->prev_enc = n->enc_len;
+  // a dirty child forces a resident-parent re-upload when its reference
+  // kind or inline bytes changed: embedded now, embedded before (incl.
+  // brand-new nodes, prev_enc == -1), or never device-hashed
+  auto unstable = [](const INode* c) {
+    return c->enc_len < 32 || c->prev_enc < 32 || c->slot < 0;
+  };
   int h = -1;
   if (n->kind == 0) {
     int payload = frag_enc_len(compact_len(n->nnib)) +
@@ -342,6 +433,7 @@ int collect(INode* n, std::vector<std::vector<INode*>>& levels) {
     n->enc_len = list_hdr_len(payload) + payload;
   } else if (n->kind == 1) {
     h = std::max(h, collect(n->child[0], levels));
+    if (n->child[0]->dirty && unstable(n->child[0])) n->structural = true;
     int payload = frag_enc_len(compact_len(n->nnib)) +
                   child_ref_len(n->child[0]);
     n->enc_len = list_hdr_len(payload) + payload;
@@ -350,6 +442,7 @@ int collect(INode* n, std::vector<std::vector<INode*>>& levels) {
     for (int i = 0; i < 16; ++i) {
       if (n->child[i]) {
         h = std::max(h, collect(n->child[i], levels));
+        if (n->child[i]->dirty && unstable(n->child[i])) n->structural = true;
         payload += child_ref_len(n->child[i]);
       } else {
         payload += 1;
@@ -363,23 +456,20 @@ int collect(INode* n, std::vector<std::vector<INode*>>& levels) {
   return h;
 }
 
-struct MiniWriter {
-  std::vector<std::pair<int32_t, INode*>>& patches;  // (byte off, dirty child)
+// One row renderer for both planners; the policy decides how a HASHED
+// child reference's 32 bytes land (literal cached digest vs zero hole)
+// and records the patch. Embedded children always inline their bytes.
+template <class Policy>
+struct RowWriter {
+  Policy policy;
   uint8_t* base;
 
   void write_child_ref(INode* c, uint8_t*& out) {
     if (c->enc_len < 32) {
       write_node(c, out);  // embedded (dirty or clean): inline bytes
-    } else if (c->dirty) {
-      *out++ = 0xA0;
-      patches.emplace_back((int32_t)(out - base), c);
-      std::memset(out, 0, 32);
-      out += 32;
     } else {
-      // clean hashed child: digest straight from the cache — the whole
-      // point of incrementality (no patch, no recompute)
       *out++ = 0xA0;
-      std::memcpy(out, c->digest, 32);
+      policy.hashed_child(c, (int32_t)(out - base), out);
       out += 32;
     }
   }
@@ -413,6 +503,22 @@ struct MiniWriter {
           *out++ = 0x80;
       }
       *out++ = 0x80;  // value slot: fixed-width keys never occupy it
+    }
+  }
+};
+
+// mini-plan policy: clean hashed children are literal digests from the
+// host cache — the whole point of host-cached incrementality; dirty ones
+// are zero holes + patches
+struct MiniPolicy {
+  std::vector<std::pair<int32_t, INode*>>& patches;  // (byte off, dirty child)
+
+  void hashed_child(INode* c, int32_t off, uint8_t* dst32) {
+    if (c->dirty) {
+      patches.emplace_back(off, c);
+      std::memset(dst32, 0, 32);
+    } else {
+      std::memcpy(dst32, c->digest, 32);
     }
   }
 };
@@ -496,7 +602,7 @@ void build_plan(Inc& t) {
       INode* n = seg.node_of_lane[lane];
       uint8_t* row = t.flat.get() + seg.byte_base + (int64_t)lane * width;
       patches.clear();
-      MiniWriter w{patches, row};
+      RowWriter<MiniPolicy> w{{patches}, row};
       uint8_t* out = row;
       w.write_node(n, out);
       int len = (int)(out - row);
@@ -526,6 +632,225 @@ void build_plan(Inc& t) {
   }
   t.root_pos = t.root->lane;
   mark_embedded_dirty(t.root, t.embedded_dirty);
+}
+
+// ---- resident plan --------------------------------------------------------
+//
+// Device-resident commits (the deferred-absorb + template-residency design,
+// PERF.md "what would close the rest" #1+#2): node rows persist in per-
+// block-class device arenas, digests persist in a device store, and a
+// commit uploads ONLY fresh/structurally-changed rows plus patch tables.
+// Parent holes are DELTA-patched: new_strip - old_strip in wrapping u32
+// arithmetic, where old is the child's previous digest (store[slot]) —
+// exact because every hole word is a sum of byte-disjoint contributions.
+// Digests never return to the host (the root is read on demand); the
+// host plans structure only, so planning commit k+1 overlaps device
+// execution of commit k. Mirrors the warm-trie semantics of
+// /root/reference/trie/trie.go:573-626 with the absorb step deferred
+// into device memory.
+
+// resident policy: zero hole + patch for EVERY hashed child (resident
+// rows never carry literal digests — all digest flow is store/dig
+// gathers on device)
+struct ResPatch {
+  int32_t off;  // byte offset of the 32-byte hole within the row
+  INode* child;
+};
+
+struct ResPolicy {
+  std::vector<ResPatch>& patches;
+
+  void hashed_child(INode* c, int32_t off, uint8_t* dst32) {
+    patches.push_back({off, c});
+    std::memset(dst32, 0, 32);
+  }
+};
+
+// free device resources of dirty nodes that fell below the hash threshold
+// (hashed -> embedded transition) and collect every embedded-dirty node so
+// mark_clean can clear its flags
+void collect_embedded_res(Inc& t, INode* n) {
+  if (!n || !n->dirty) return;
+  if (n->enc_len < 32 && n->lane < 0) {
+    t.release_device(n);
+    t.r_embedded_dirty.push_back(n);
+  }
+  if (n->kind == 1) collect_embedded_res(t, n->child[0]);
+  if (n->kind == 2)
+    for (int i = 0; i < 16; ++i) collect_embedded_res(t, n->child[i]);
+}
+
+bool build_plan_res(Inc& t) {
+  t.rsegs.clear();
+  for (auto& c : t.rcls) {
+    c.fresh_rows.clear();
+    c.fresh_idx.clear();
+  }
+  t.r_rowidx.clear();
+  t.r_lane_slot.clear();
+  t.r_dstw.clear();
+  t.r_digidx.clear();
+  t.r_storeidx.clear();
+  t.r_oldidx.clear();
+  t.r_shift.clear();
+  t.r_embedded_dirty.clear();
+  t.r_root_lane = -1;
+  t.r_total_lanes = t.r_total_patches = t.r_num_dirty = 0;
+  t.r_fresh_bytes = 0;
+  if (!t.root || !t.root->dirty) return true;
+
+  std::vector<std::vector<INode*>> levels;
+  collect(t.root, levels);
+
+  struct Key {
+    int level, blocks;
+  };
+  std::vector<std::pair<Key, INode*>> entries;
+  for (size_t h = 0; h < levels.size(); ++h)
+    for (INode* n : levels[h]) {
+      bool hashed = n->enc_len >= 32 || n == t.root;
+      n->lane = -1;
+      if (!hashed) continue;
+      int blocks = n->enc_len / kRate + 1;
+      if (blocks > kMaxBlocks) return false;  // >8.6KB node RLP unsupported
+      entries.push_back({{(int)h, blocks}, n});
+    }
+  std::stable_sort(entries.begin(), entries.end(),
+                   [](const auto& a, const auto& b) {
+                     return a.first.level != b.first.level
+                                ? a.first.level < b.first.level
+                                : a.first.blocks < b.first.blocks;
+                   });
+  t.r_num_dirty = (int64_t)entries.size();
+
+  // pass 1: segments, lanes, slot/row allocation, fresh-row classification
+  int32_t gstart = 0;
+  size_t i = 0;
+  while (i < entries.size()) {
+    size_t j = i;
+    while (j < entries.size() &&
+           entries[j].first.level == entries[i].first.level &&
+           entries[j].first.blocks == entries[i].first.blocks)
+      ++j;
+    int count = (int)(j - i);
+    ResSeg seg;
+    seg.blocks = entries[i].first.blocks;
+    seg.lanes = round_lanes(count);
+    seg.gstart = gstart;
+    seg.lane_off = (int32_t)t.r_rowidx.size();
+    for (size_t k = i; k < j; ++k) {
+      INode* n = entries[k].second;
+      n->lane = gstart + (int32_t)(k - i);
+      seg.node_of_lane.push_back(n);
+      if (n->slot < 0) n->slot = t.alloc_slot();
+      bool upload = n->structural || n->row < 0 || n->row_blocks != seg.blocks;
+      if (upload) {
+        if (n->row >= 0 && n->row_blocks != seg.blocks) {
+          t.rcls[n->row_blocks].free_rows.push_back(n->row);
+          n->row = -1;
+        }
+        auto& cls = t.rcls[seg.blocks];
+        if (n->row < 0) {
+          if (!cls.free_rows.empty()) {
+            n->row = cls.free_rows.back();
+            cls.free_rows.pop_back();
+          } else {
+            n->row = cls.next_row++;
+          }
+          n->row_blocks = (uint8_t)seg.blocks;
+        }
+      }
+      seg.fresh_of_lane.push_back(upload ? 1 : 0);
+      t.r_rowidx.push_back(n->row);
+      t.r_lane_slot.push_back(n->slot);
+    }
+    for (int k = count; k < seg.lanes; ++k) {  // pad lanes
+      t.r_rowidx.push_back(0);    // arena scratch row
+      t.r_lane_slot.push_back(1); // store scratch slot
+    }
+    gstart += seg.lanes;
+    t.rsegs.push_back(std::move(seg));
+    i = j;
+  }
+  t.r_total_lanes = gstart;
+  t.r_root_lane = t.root->lane;
+
+  // pass 2: render rows (fresh ones into the packed upload buffers,
+  // patch-only ones into scratch for offsets) and emit delta patches
+  thread_local std::vector<uint8_t> scratch;
+  if ((int)scratch.size() < kMaxBlocks * kRate)
+    scratch.resize(kMaxBlocks * kRate);
+  std::vector<ResPatch> patches;
+  for (auto& seg : t.rsegs) {
+    int width = seg.blocks * kRate;
+    seg.patch_off = (int32_t)t.r_dstw.size();
+    int np = 0;
+    for (size_t lane = 0; lane < seg.node_of_lane.size(); ++lane) {
+      INode* n = seg.node_of_lane[lane];
+      bool upload = seg.fresh_of_lane[lane] != 0;
+      patches.clear();
+      uint8_t* row;
+      if (upload) {
+        auto& cls = t.rcls[seg.blocks];
+        size_t base = cls.fresh_rows.size();
+        cls.fresh_rows.resize(base + width);
+        row = cls.fresh_rows.data() + base;
+        cls.fresh_idx.push_back(n->row);
+        RowWriter<ResPolicy> w{{patches}, row};
+        uint8_t* out = row;
+        w.write_node(n, out);
+        int len = (int)(out - row);
+        std::memset(row + len, 0, width - len);
+        row[len] ^= 0x01;  // keccak pad
+        row[width - 1] ^= 0x80;
+        t.r_fresh_bytes += width;
+      } else {
+        row = scratch.data();
+        RowWriter<ResPolicy> w{{patches}, row};
+        uint8_t* out = row;
+        w.write_node(n, out);  // offsets only; bytes discarded
+      }
+      for (auto& pr : patches) {
+        INode* c = pr.child;
+        bool cdirty = c->dirty;  // dirty hashed child: digest from dig
+        if (!upload && !cdirty) continue;  // resident hole already correct
+        int64_t byte_off = (int64_t)n->row * width + pr.off;
+        t.r_dstw.push_back((int32_t)(byte_off >> 2));
+        t.r_shift.push_back((int32_t)(byte_off & 3));
+        t.r_digidx.push_back(cdirty ? c->lane + 1 : 0);
+        t.r_storeidx.push_back(cdirty ? 0 : c->slot);
+        // patch-only rows subtract the child's previous digest (the hole
+        // currently holds it); fresh rows have zero holes, so old = 0
+        t.r_oldidx.push_back(upload ? 0 : c->slot);
+        ++np;
+      }
+    }
+    seg.n_patches = np ? pow2_at_least(np, 16) : 0;
+    for (int k = np; k < seg.n_patches; ++k) {  // zero-delta pad patches
+      t.r_dstw.push_back(0);
+      t.r_shift.push_back(0);
+      t.r_digidx.push_back(0);
+      t.r_storeidx.push_back(0);
+      t.r_oldidx.push_back(0);
+    }
+    t.r_total_patches += seg.n_patches;
+  }
+  collect_embedded_res(t, t.root);
+  return true;
+}
+
+void res_mark_clean(Inc& t) {
+  for (auto& seg : t.rsegs)
+    for (INode* n : seg.node_of_lane) {
+      n->dirty = false;
+      n->structural = false;
+      n->lane = -1;
+    }
+  for (INode* n : t.r_embedded_dirty) {
+    n->dirty = false;
+    n->structural = false;
+  }
+  t.r_embedded_dirty.clear();
 }
 
 void absorb_digests(Inc& t, const uint8_t* dig) {
@@ -671,6 +996,81 @@ void mpt_inc_absorb(void* h, const uint8_t* dig, uint8_t* out_root32) {
     std::memcpy(out_root32, dig + (int64_t)t->root_pos * 32, 32);
   absorb_digests(*t, dig);
 }
+
+// ---- resident-plan ABI ----------------------------------------------------
+
+// Build the resident plan. Returns the segment count, or UINT64_MAX on
+// failure (a node wider than kMaxBlocks rate blocks).
+uint64_t mpt_inc_plan_res(void* h) {
+  Inc* t = (Inc*)h;
+  if (!build_plan_res(*t)) return (uint64_t)-1;
+  return t->rsegs.size();
+}
+
+// out[7]: total_lanes, total_patches, store_slots_needed (next_slot),
+// root_lane, num_dirty_hashed, fresh_row_bytes, n_classes (kMaxBlocks+1)
+void mpt_inc_res_meta(void* h, int64_t* out) {
+  Inc* t = (Inc*)h;
+  out[0] = t->r_total_lanes;
+  out[1] = t->r_total_patches;
+  out[2] = t->next_slot;
+  out[3] = t->r_root_lane;
+  out[4] = t->r_num_dirty;
+  out[5] = t->r_fresh_bytes;
+  out[6] = kMaxBlocks + 1;
+}
+
+// per segment, 6 ints: blocks, lanes, gstart, n_patches, patch_off, lane_off
+void mpt_inc_res_specs(void* h, int32_t* out) {
+  Inc* t = (Inc*)h;
+  for (size_t s = 0; s < t->rsegs.size(); ++s) {
+    const ResSeg& g = t->rsegs[s];
+    out[6 * s + 0] = g.blocks;
+    out[6 * s + 1] = g.lanes;
+    out[6 * s + 2] = g.gstart;
+    out[6 * s + 3] = g.n_patches;
+    out[6 * s + 4] = g.patch_off;
+    out[6 * s + 5] = g.lane_off;
+  }
+}
+
+// per class, 2 ints: fresh row count, arena rows needed (next_row)
+void mpt_inc_res_cls_counts(void* h, int32_t* out) {
+  Inc* t = (Inc*)h;
+  for (int c = 0; c <= kMaxBlocks; ++c) {
+    out[2 * c + 0] = (int32_t)(t->rcls[c].fresh_idx.size());
+    out[2 * c + 1] = t->rcls[c].next_row;
+  }
+}
+
+void mpt_inc_res_fresh(void* h, int32_t cls, uint8_t* rows, int32_t* idx) {
+  Inc* t = (Inc*)h;
+  auto& c = t->rcls[cls];
+  if (!c.fresh_rows.empty())
+    std::memcpy(rows, c.fresh_rows.data(), c.fresh_rows.size());
+  if (!c.fresh_idx.empty())
+    std::memcpy(idx, c.fresh_idx.data(), c.fresh_idx.size() * 4);
+}
+
+void mpt_inc_res_tables(void* h, int32_t* rowidx, int32_t* lane_slot,
+                        int32_t* dstw, int32_t* digidx, int32_t* storeidx,
+                        int32_t* oldidx, int32_t* shift) {
+  Inc* t = (Inc*)h;
+  auto cp = [](const std::vector<int32_t>& v, int32_t* out) {
+    if (!v.empty()) std::memcpy(out, v.data(), v.size() * 4);
+  };
+  cp(t->r_rowidx, rowidx);
+  cp(t->r_lane_slot, lane_slot);
+  cp(t->r_dstw, dstw);
+  cp(t->r_digidx, digidx);
+  cp(t->r_storeidx, storeidx);
+  cp(t->r_oldidx, oldidx);
+  cp(t->r_shift, shift);
+}
+
+// After the device program is dispatched: clear dirty/structural flags.
+// Digests deliberately do NOT return to the host (deferred absorb).
+void mpt_inc_res_mark_clean(void* h) { res_mark_clean(*(Inc*)h); }
 
 void mpt_inc_root(void* h, uint8_t* out32) {
   Inc* t = (Inc*)h;
