@@ -352,6 +352,21 @@ def main():
     REPORT["vs_baseline"] = round(big["cpu_s"] / big_s, 3)
     REPORT["scope"] = "big"
 
+    # ------------------------------------------- resident-commit leg
+    # The deferred-absorb + template-residency design (VERDICT r4 items
+    # 1+2): device-persistent digest store + row arenas, delta patches,
+    # pipelined dispatch (roots checked with one commit of lag). This is
+    # the leg that must win at 90 MB/s-class bandwidth.
+    try:
+        res_result = run_resident(wd, planned_kernel=kernel)
+        REPORT.update(res_result)
+        if res_result.get("res_vs_cpu", 0.0) > REPORT["vs_baseline"]:
+            REPORT["value"] = res_result["res_tpu_nodes_per_sec"]
+            REPORT["vs_baseline"] = res_result["res_vs_cpu"]
+            REPORT["scope"] = f"resident-{res_result['res_leaves']}"
+    except Exception as e:  # noqa: BLE001 — earlier numbers still stand
+        REPORT["res_error"] = f"{type(e).__name__}: {e}"
+
     # ------------------------------------------- incremental-commit leg
     # BASELINE's north-star workload shape: a 1M-account trie committed
     # repeatedly with K-account churn. Both sides keep the trie warm and
@@ -374,23 +389,113 @@ def main():
     emit()
 
 
-def run_incremental(wd, planned):
-    """Repeated-churn commits on a large warm trie: CPU-incremental vs
-    device-incremental, bit-exact roots every round."""
-    import random
+def run_resident(wd, planned_kernel="xla"):
+    """Steady-state device-resident commits on a large warm trie.
 
-    from coreth_tpu.native.mpt import IncrementalTrie, load_inc
+    The device loop is PIPELINED: each round applies updates, plans, and
+    dispatches without synchronizing; every root is verified against the
+    host oracle after the loop. Steady-state throughput is therefore
+    nodes/max(plan, transfer+kernel) — the deferred-absorb design goal.
+    h2d bytes are measured exactly (the executor counts every upload), so
+    the report includes modeled transfer times at both observed tunnel
+    bandwidths (90 MB/s wedge-day, 1.6 GB/s healthy) alongside the
+    measured wall-clock."""
+    import numpy as np
+
+    from coreth_tpu.native.mpt import load_inc
+    from coreth_tpu.ops.keccak_resident import ResidentExecutor
 
     if load_inc() is None:
-        return {"inc_error": "native incremental planner unavailable"}
+        return {"res_error": "native incremental planner unavailable"}
+    wd.arm("resident-build", 300)
+    rng, cpu_tree, dev_tree, keys, n, churn, rounds, threads = \
+        build_inc_workload()
+    seg_impl = None
+    if planned_kernel == "pallas":
+        from coreth_tpu.ops.keccak_pallas import staged_seg_impl
+
+        seg_impl = staged_seg_impl()
+    ex = ResidentExecutor(seg_impl=seg_impl)
+    out = {"res_leaves": n, "res_churn": churn, "res_rounds": rounds}
+
+    # initial commits (cold: compiles + full-trie upload)
+    wd.arm("resident-warmup", 900)
+    t0 = time.perf_counter()
+    r0 = ex.root_bytes(dev_tree.commit_resident(ex))
+    out["res_initial_s"] = round(time.perf_counter() - t0, 3)
+    out["res_initial_h2d_mb"] = round(ex.h2d_bytes / 1e6, 1)
+    r0_cpu = cpu_tree.commit_cpu(threads=threads)
+    assert r0 == r0_cpu, "resident initial root mismatch"
+
+    # steady state: both legs process IDENTICAL batches END TO END
+    # (update + commit both timed — update is real per-block work shared
+    # by both designs); batch 0 is the untimed warmup where device-shape
+    # compiles land. Pre-generated so batch construction isn't timed.
+    batches = [
+        [(keys[rng.randrange(n)], rng.randbytes(60)) for _ in range(churn)]
+        for _ in range(rounds + 1)
+    ]
+    cpu_roots, cpu_t, dirty_total = [], 0.0, 0
+    for rnd, batch in enumerate(batches):
+        wd.arm(f"resident-cpu-{rnd}", 240)
+        t0 = time.perf_counter()
+        cpu_tree.update(batch)
+        cpu_roots.append(cpu_tree.commit_cpu(threads=threads))
+        dt = time.perf_counter() - t0
+        if rnd > 0:
+            cpu_t += dt
+            dirty_total += cpu_tree.dirty_stats()[0]
+
+    wd.arm("resident-shape-warm", 600)
+    dev_tree.update(batches[0])
+    rw = ex.root_bytes(dev_tree.commit_resident(ex))
+    assert rw == cpu_roots[0], "resident warmup root mismatch"
+
+    wd.arm("resident-measure", 600)
+    handles, h2d_total = [], 0
+    t_start = time.perf_counter()
+    for batch in batches[1:]:
+        dev_tree.update(batch)
+        handles.append(dev_tree.commit_resident(ex))
+        h2d_total += ex.h2d_bytes
+    # single synchronization point: block on the last root
+    np.asarray(handles[-1])
+    dev_t = time.perf_counter() - t_start
+
+    # verify every pipelined root against the host oracle
+    wd.arm("resident-verify", 300)
+    for rnd, handle in enumerate(handles):
+        assert ex.root_bytes(handle) == cpu_roots[rnd + 1], \
+            f"pipelined resident root mismatch (round {rnd})"
+
+    out["res_dirty_nodes"] = dirty_total
+    out["res_h2d_bytes_per_node"] = round(h2d_total / max(dirty_total, 1), 1)
+    out["res_h2d_mb_per_commit"] = round(h2d_total / rounds / 1e6, 2)
+    out["res_cpu_nodes_per_sec"] = round(dirty_total / cpu_t, 1)
+    out["res_tpu_nodes_per_sec"] = round(dirty_total / dev_t, 1)
+    out["res_vs_cpu"] = round(cpu_t / dev_t, 3)
+    # bandwidth model: measured h2d at the two observed tunnel rates
+    per_commit = h2d_total / rounds
+    out["res_modeled_transfer_s_at_90MBps"] = round(per_commit / 90e6, 3)
+    out["res_modeled_transfer_s_at_1600MBps"] = round(per_commit / 1.6e9, 3)
+    return out
+
+
+
+def build_inc_workload():
+    """Shared setup for the incremental/resident legs: env knobs, the
+    deterministic leaf set (seed 7), and a fresh CPU+device trie pair.
+    Returns (rng, cpu_tree, dev_tree, keys, n, churn, rounds, threads)."""
+    import random
+
+    from coreth_tpu.native.mpt import IncrementalTrie
+
     n = int(os.environ.get("CORETH_TPU_BENCH_INC_LEAVES", "1000000"))
     churn = int(os.environ.get("CORETH_TPU_BENCH_INC_CHURN", "50000"))
     rounds = int(os.environ.get("CORETH_TPU_BENCH_INC_ROUNDS", "4"))
     threads = int(os.environ.get("CORETH_TPU_BENCH_CPU_THREADS", "0")) or (
         os.cpu_count() or 1
     )
-
-    wd.arm("incremental-build", 300)
     rng = random.Random(7)
     items = sorted(
         {rng.randbytes(32): rng.randbytes(rng.randint(40, 90))
@@ -399,6 +504,19 @@ def run_incremental(wd, planned):
     cpu_tree = IncrementalTrie(items)
     dev_tree = IncrementalTrie(items)
     keys = [k for k, _ in items]
+    return rng, cpu_tree, dev_tree, keys, n, churn, rounds, threads
+
+
+def run_incremental(wd, planned):
+    """Repeated-churn commits on a large warm trie: CPU-incremental vs
+    device-incremental, bit-exact roots every round."""
+    from coreth_tpu.native.mpt import load_inc
+
+    if load_inc() is None:
+        return {"inc_error": "native incremental planner unavailable"}
+    wd.arm("incremental-build", 300)
+    rng, cpu_tree, dev_tree, keys, n, churn, rounds, threads = \
+        build_inc_workload()
     out = {"inc_leaves": n, "inc_churn": churn, "inc_rounds": rounds}
 
     # initial commits (cold; the device one also compiles the mini shapes)
