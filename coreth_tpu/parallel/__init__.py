@@ -147,16 +147,45 @@ def commit_step(mesh: Mesh, axis="batch"):
     return run
 
 
-def sharded_seg_impl(mesh: Mesh, axis: str = "batch"):
+def sharded_seg_impl(mesh: Mesh, axis: str = "batch", seg_impl=None):
     """Per-segment keccak for ops.keccak_planned.PlannedCommit with the
     lane dimension sharded across [mesh] (SURVEY §2.7: the 16-goroutine
     hasher fan-out re-landed as data parallelism over ICI).
 
     Composition: the planned executor's surrounding ops (patch gathers,
     scatter-add, digest updates) stay replicated — only the keccak FLOPs
-    shard. GSPMD inserts the all-gather of digests back to replicated;
-    lanes are always a multiple of 16 (planner bucketing), so every mesh
-    size up to 16 divides evenly."""
+    shard. Lanes are always a multiple of 16 (planner bucketing), so every
+    mesh size up to 16 divides evenly.
+
+    seg_impl=None: the XLA scan kernel, partitioned by GSPMD via sharding
+    constraints. seg_impl given (e.g. keccak_pallas.staged_seg_impl): the
+    kernel is mapped per-device with shard_map — a pallas_call is a custom
+    call GSPMD cannot split, so each device runs the kernel on its own
+    lane shard (the exact partitioning a pod would use); the impl's own
+    static shape logic (Pallas for %1024-lane shards, XLA below) applies
+    PER SHARD. GSPMD/shard_map inserts the digest all-gather back to
+    replicated either way."""
+    if seg_impl is not None:
+        from jax import shard_map
+
+        out_replicated = NamedSharding(mesh, P())
+
+        def impl(words):
+            # check_vma=False: pallas_call's out_shape carries no varying-
+            # mesh-axes annotation, and the kernel is per-shard pure data
+            # parallelism anyway (no cross-shard collectives to validate)
+            out = shard_map(
+                seg_impl, mesh=mesh,
+                in_specs=(P(axis, None, None),), out_specs=P(axis, None),
+                check_vma=False,
+            )(words)
+            # all-gather digests back to replicated, matching the GSPMD
+            # branch: the planned step's surrounding ops (patch gathers
+            # over arbitrary child lanes, dig updates) assume it
+            return jax.lax.with_sharding_constraint(out, out_replicated)
+
+        return impl
+
     from ..ops.keccak_staged import _segment_keccak
 
     lane_sharded = NamedSharding(mesh, P(axis, None, None))
