@@ -245,3 +245,161 @@ class EthAPI:
     def getLogs(self, filter_obj: dict) -> list:
         logs = self.b.filters.get_logs(filter_obj)
         return [self._marshal_log(l, i) for i, l in enumerate(logs)]
+
+    # --- keystore-backed accounts (internal/ethapi/api.go:276-460) -------
+
+    def accounts(self) -> list:
+        """eth_accounts: addresses the node's keystore can sign for."""
+        if self.b.keystore is None:
+            return []
+        return [hb(a.address) for a in self.b.keystore.accounts()]
+
+    def sign(self, address: str, data: str) -> str:
+        """eth_sign: personal-message signature by an UNLOCKED account
+        (api.go:444: the \\x19Ethereum Signed Message prefix guards
+        against signing raw txs)."""
+        from ..accounts.keystore import KeyStoreError
+
+        ks = self.b.require_keystore()
+        msg = parse_bytes(data)
+        try:
+            sig = ks.sign_hash(parse_addr(address), _personal_hash(msg))
+        except KeyStoreError as e:
+            raise RPCError(-32000, str(e))
+        return hb(sig[:64] + bytes([sig[64] + 27]))
+
+    def signTransaction(self, tx_obj: dict) -> dict:
+        """eth_signTransaction: fill defaults, sign with the unlocked
+        keystore account, return the raw RLP without submitting."""
+        tx = self.b.sign_tx_with_keystore(tx_obj)
+        return {"raw": hb(tx.encode()), "tx": self._marshal_tx(tx, None, 0)}
+
+    def sendTransaction(self, tx_obj: dict) -> str:
+        """eth_sendTransaction: sign with the unlocked keystore account
+        and submit to the pool (api.go:276 SendTransaction)."""
+        tx = self.b.sign_tx_with_keystore(tx_obj)
+        self.b.send_tx(tx)
+        return hb(tx.hash())
+
+    def getProof(self, address: str, storage_keys: list,
+                 block: str = "latest") -> dict:
+        """eth_getProof (api.go:669): merkle proofs of an account and a
+        set of its storage slots against the block's state root."""
+        addr = parse_addr(address)
+        keys = [parse_hex(k).to_bytes(32, "big") for k in storage_keys or []]
+        res = self.b.get_proof(addr, keys, block)
+        acct = res["account"]
+        return {
+            "address": hb(addr),
+            "accountProof": [hb(n) for n in res["account_proof"]],
+            "balance": hx(acct.balance),
+            "codeHash": hb(acct.code_hash),
+            "nonce": hx(acct.nonce),
+            "storageHash": hb(acct.root),
+            "storageProof": [
+                {
+                    "key": hb(key),
+                    "value": hx(int.from_bytes(val, "big") if val else 0),
+                    "proof": [hb(n) for n in proof],
+                }
+                for key, val, proof in res["storage_proof"]
+            ],
+        }
+
+
+def _personal_hash(msg: bytes) -> bytes:
+    """accounts.TextHash: keccak over the EIP-191 personal-message
+    envelope."""
+    from ..native import keccak256
+
+    return keccak256(
+        b"\x19Ethereum Signed Message:\n" + str(len(msg)).encode() + msg)
+
+
+class PersonalAPI:
+    """personal_* namespace (internal/ethapi/api.go:210-520): keystore
+    lifecycle + passphrase-scoped signing."""
+
+    def __init__(self, backend):
+        self.b = backend
+
+    def listAccounts(self) -> list:
+        return EthAPI(self.b).accounts()
+
+    def newAccount(self, password: str) -> str:
+        ks = self.b.require_keystore()
+        return hb(ks.new_account(password).address)
+
+    def importRawKey(self, priv_hex: str, password: str) -> str:
+        ks = self.b.require_keystore()
+        priv = parse_bytes(priv_hex)
+        if len(priv) != 32:
+            raise RPCError(-32602, "private key must be 32 bytes")
+        return hb(ks.import_key(priv, password).address)
+
+    def unlockAccount(self, address: str, password: str,
+                      duration=None) -> bool:
+        """geth semantics (api.go UnlockAccount): duration omitted ->
+        300 s auto-relock; explicit 0 -> unlocked until lockAccount."""
+        from ..accounts.keystore import KeyStoreError
+
+        if duration is None:
+            timeout = 300.0
+        elif duration == 0:
+            timeout = None
+        else:
+            timeout = float(duration)
+        ks = self.b.require_keystore()
+        try:
+            ks.unlock(parse_addr(address), password, timeout=timeout)
+        except KeyStoreError as e:
+            raise RPCError(-32000, str(e))
+        return True
+
+    def lockAccount(self, address: str) -> bool:
+        self.b.require_keystore().lock_account(parse_addr(address))
+        return True
+
+    def sign(self, data: str, address: str, password: str) -> str:
+        from ..accounts.keystore import KeyStoreError
+
+        ks = self.b.require_keystore()
+        try:
+            sig = ks.sign_hash_with_passphrase(
+                parse_addr(address), password, _personal_hash(parse_bytes(data)))
+        except KeyStoreError as e:
+            raise RPCError(-32000, str(e))
+        return hb(sig[:64] + bytes([sig[64] + 27]))
+
+    def ecRecover(self, data: str, sig_hex: str) -> str:
+        from ..crypto.secp256k1 import recover_address
+
+        sig = parse_bytes(sig_hex)
+        if len(sig) != 65:
+            raise RPCError(-32602, "signature must be 65 bytes")
+        v = sig[64]
+        if v >= 27:
+            v -= 27
+        addr = recover_address(
+            _personal_hash(parse_bytes(data)), v,
+            int.from_bytes(sig[:32], "big"), int.from_bytes(sig[32:64], "big"))
+        if addr is None:
+            raise RPCError(-32000, "invalid signature")
+        return hb(addr)
+
+    def sendTransaction(self, tx_obj: dict, password: str) -> str:
+        """personal_sendTransaction: sign with the passphrase (no prior
+        unlock needed) and submit."""
+        from ..accounts.keystore import KeyStoreError
+        from ..core.types import Signer
+
+        ks = self.b.require_keystore()
+        tx = self.b.fill_tx(tx_obj)
+        addr = parse_addr(tx_obj["from"])
+        try:
+            priv = ks.export_key(addr, password)
+        except KeyStoreError as e:
+            raise RPCError(-32000, str(e))
+        tx = Signer(self.b.chain_config.chain_id).sign(tx, priv)
+        self.b.send_tx(tx)
+        return hb(tx.hash())

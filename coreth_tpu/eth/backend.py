@@ -20,12 +20,22 @@ from .filters import FilterSystem
 from .gasprice import Oracle
 
 
+def require_keystore(keystore):
+    """Shared guard for every keystore-backed RPC (eth/personal/avax)."""
+    if keystore is None:
+        raise RPCError(
+            -32000, "keystore not configured (set keystore-directory)")
+    return keystore
+
+
 class EthBackend:
-    def __init__(self, chain, txpool, allow_unfinalized_queries: bool = False):
+    def __init__(self, chain, txpool, allow_unfinalized_queries: bool = False,
+                 keystore=None):
         self.chain = chain
         self.txpool = txpool
         self.chain_config = chain.config
         self.allow_unfinalized_queries = allow_unfinalized_queries
+        self.keystore = keystore  # accounts.KeyStore | None (node/ role)
         self.filters = FilterSystem(self)
         self.gpo = Oracle(self)
 
@@ -119,6 +129,102 @@ class EthBackend:
             state, self.chain_config, Config(no_base_fee=True),
         )
         return apply_message(evm, msg, GasPool(2**63))
+
+    # --- keystore-backed signing (internal/ethapi/api.go:276-460) --------
+
+    def require_keystore(self):
+        return require_keystore(self.keystore)
+
+    def fill_tx(self, obj: dict) -> Transaction:
+        """setDefaults (internal/ethapi/transaction_args.go): nonce from
+        the pool, fees from the oracle, gas from estimation."""
+        if not obj.get("from"):
+            raise RPCError(-32602, "missing 'from' address")
+        from_ = parse_addr(obj["from"])
+        to = parse_addr(obj["to"]) if obj.get("to") else None
+        value = parse_hex(obj["value"]) if obj.get("value") else 0
+        data = parse_bytes(obj.get("data") or obj.get("input") or "0x")
+        nonce = (parse_hex(obj["nonce"]) if obj.get("nonce")
+                 else self.txpool.nonce(from_))
+        if obj.get("maxFeePerGas") or obj.get("maxPriorityFeePerGas"):
+            tip = (parse_hex(obj["maxPriorityFeePerGas"])
+                   if obj.get("maxPriorityFeePerGas")
+                   else self.suggest_gas_tip_cap())
+            if obj.get("maxFeePerGas"):
+                max_fee = parse_hex(obj["maxFeePerGas"])
+            else:
+                # geth setDefaults: feeCap = 2*baseFee + tip, so the tx
+                # survives base-fee growth and always covers the tip
+                base = self.last_accepted_block().base_fee or 0
+                max_fee = 2 * base + tip
+            if max_fee < tip:
+                raise RPCError(
+                    -32602,
+                    f"maxFeePerGas ({max_fee}) < maxPriorityFeePerGas "
+                    f"({tip})")
+            if not obj.get("maxPriorityFeePerGas"):
+                tip = min(tip, max_fee)
+            tx = Transaction(
+                type=2, chain_id=self.chain_config.chain_id, nonce=nonce,
+                max_fee=max_fee, max_priority_fee=tip, gas_price=max_fee,
+                to=to, value=value, data=data,
+            )
+        else:
+            gas_price = (parse_hex(obj["gasPrice"]) if obj.get("gasPrice")
+                         else self.suggest_gas_price())
+            tx = Transaction(
+                type=0, chain_id=self.chain_config.chain_id, nonce=nonce,
+                gas_price=gas_price, to=to, value=value, data=data,
+            )
+        if obj.get("gas"):
+            tx.gas = parse_hex(obj["gas"])
+        else:
+            est = dict(obj)
+            est.pop("nonce", None)  # estimation state is the latest block
+            tx.gas = self.estimate_gas(est, "latest")
+        return tx
+
+    def sign_tx_with_keystore(self, obj: dict) -> Transaction:
+        from ..accounts.keystore import KeyStoreError
+
+        ks = self.require_keystore()
+        tx = self.fill_tx(obj)
+        try:
+            return ks.sign_tx(parse_addr(obj["from"]), tx,
+                              self.chain_config.chain_id)
+        except KeyStoreError as e:
+            raise RPCError(-32000, str(e))
+
+    # --- merkle proofs (internal/ethapi/api.go:669 GetProof) -------------
+
+    def get_proof(self, addr: bytes, storage_keys, tag: str) -> dict:
+        from ..native import keccak256
+        from ..state.account import Account
+        from ..trie.proof import prove
+
+        blk = self.block_by_tag(tag)
+        if blk is None:
+            raise RPCError(-32000, "block not found")
+        state_trie = self.chain.state_database.open_trie(blk.root)
+        account_proof = prove(state_trie.trie, keccak256(addr))
+        blob = state_trie.get(addr)
+        acct = Account.decode(blob) if blob else Account()
+        storage_proof = []
+        if storage_keys:
+            storage_trie = self.chain.state_database.open_storage_trie(
+                keccak256(addr), acct.root)
+            from .. import rlp
+
+            for key in storage_keys:
+                proof = prove(storage_trie.trie, keccak256(key))
+                enc = storage_trie.get(key)
+                val = rlp.decode(enc) if enc else b""
+                storage_proof.append((key, val, proof))
+        return {
+            "account": acct,
+            "account_proof": account_proof,
+            "storage_proof": storage_proof,
+        }
 
     def estimate_gas(self, call_obj: dict, tag: str) -> int:
         """Binary search over gas (internal/ethapi estimateGas)."""

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-from ..eth.api import EthAPI, hb, hx, parse_bytes
+from ..eth.api import EthAPI, PersonalAPI, hb, hx, parse_bytes
 from ..eth.backend import EthBackend
 from ..eth.tracers import DebugAPI
 from ..rpc.server import RPCError, RPCServer
@@ -68,6 +68,100 @@ class AvaxAPI:
 
     def version(self) -> dict:
         return {"version": "coreth-tpu/0.1.0"}
+
+    # --- key management + wallet-side atomic txs (service.go:108-460) ----
+    #
+    # The reference scopes keys to an avalanchego per-user keystore
+    # (username+password); this framework's analog is the node's
+    # directory keystore (accounts/keystore.py) with per-key passwords —
+    # the password plays both roles, so the RPC shapes keep the
+    # reference's field names minus `username`.
+
+    def _keystore(self):
+        from ..eth.backend import require_keystore
+
+        return require_keystore(getattr(self.vm, "keystore", None))
+
+    def importKey(self, password: str, privateKey: str) -> dict:
+        """service.go:141 ImportKey: store a private key, return its
+        EVM address."""
+        priv = parse_bytes(privateKey)
+        if len(priv) != 32:
+            raise RPCError(-32602, "private key must be 32 bytes")
+        acct = self._keystore().import_key(priv, password)
+        return {"address": hb(acct.address)}
+
+    def exportKey(self, password: str, address: str) -> dict:
+        """service.go:108 ExportKey: reveal the private key for an owned
+        address (password-checked)."""
+        from ..accounts.keystore import KeyStoreError
+        from ..eth.api import parse_addr
+
+        try:
+            priv = self._keystore().export_key(parse_addr(address), password)
+        except KeyStoreError as e:
+            raise RPCError(-32000, str(e))
+        return {"privateKey": hb(priv)}
+
+    def _unlocked_keys(self, password: str):
+        """Decrypt every keystore key the password opens (the analog of
+        the reference's per-user key list)."""
+        from ..accounts.keystore import KeyStoreError
+
+        ks = self._keystore()
+        keys = []
+        for acct in ks.accounts():
+            try:
+                keys.append(ks.export_key(acct.address, password))
+            except KeyStoreError:
+                continue
+        if not keys:
+            raise RPCError(-32000, "password unlocks no keystore keys")
+        return keys
+
+    def _import_impl(self, password: str, to: str,
+                     sourceChain: str = "") -> dict:
+        """service.go Import: build+sign+issue an ImportTx consuming the
+        keystore's UTXOs from [sourceChain] to EVM address [to].
+        Registered EXPLICITLY as wire method "avax_import" ("import" is a
+        python keyword; the leading underscore keeps register_api from
+        exposing a stray avax_import_ alias)."""
+        from ..eth.api import parse_addr
+        from .atomic_tx import AtomicTxError
+        from .tx_builder import new_import_tx
+
+        source = (parse_bytes(sourceChain) if sourceChain
+                  else self.vm.ctx.x_chain_id)
+        try:
+            tx = new_import_tx(
+                self.vm, parse_addr(to), source,
+                self._unlocked_keys(password))
+            self.vm.issue_atomic_tx(tx)
+        except AtomicTxError as e:
+            raise RPCError(-32000, str(e))
+        return {"txID": hb(tx.id())}
+
+    def export(self, password: str, amount, to: str,
+               destinationChain: str = "", assetID: str = "") -> dict:
+        """service.go Export/ExportAVAX: build+sign+issue an ExportTx of
+        [amount] nAVAX (or [assetID] units) to [to] on the destination
+        chain."""
+        from ..eth.api import parse_addr
+        from .atomic_tx import AtomicTxError
+        from .tx_builder import new_export_tx
+
+        dest = (parse_bytes(destinationChain) if destinationChain
+                else self.vm.ctx.x_chain_id)
+        asset = parse_bytes(assetID) if assetID else self.vm.avax_asset_id
+        amt = amount if isinstance(amount, int) else int(amount, 0)
+        try:
+            tx = new_export_tx(
+                self.vm, amt, asset, dest, parse_addr(to),
+                self._unlocked_keys(password))
+            self.vm.issue_atomic_tx(tx)
+        except AtomicTxError as e:
+            raise RPCError(-32000, str(e))
+        return {"txID": hb(tx.id())}
 
 
 class _StackSampler:
@@ -334,7 +428,8 @@ def health_check(vm) -> dict:
 
 def create_handlers(vm, allow_unfinalized_queries: bool = False) -> RPCServer:
     """CreateHandlers (vm.go:1138): the full RPC surface on one server."""
-    backend = EthBackend(vm.blockchain, vm.txpool, allow_unfinalized_queries)
+    backend = EthBackend(vm.blockchain, vm.txpool, allow_unfinalized_queries,
+                         keystore=getattr(vm, "keystore", None))
     vm.eth_backend = backend
     server = RPCServer()
     eth = EthAPI(backend)
@@ -346,11 +441,16 @@ def create_handlers(vm, allow_unfinalized_queries: bool = False) -> RPCServer:
                     filters_api.newPendingTransactionFilter)
     server.register("eth", "uninstallFilter", filters_api.uninstallFilter)
     server.register("eth", "getFilterChanges", filters_api.getFilterChanges)
+    server.register_api("personal", PersonalAPI(backend))
     server.register_api("debug", DebugAPI(backend))
     server.register_api("txpool", TxPoolAPI(backend))
     server.register_api("net", NetAPI(vm.network_id))
     server.register_api("web3", Web3API())
-    server.register_api("avax", AvaxAPI(vm))
+    avax_api = AvaxAPI(vm)
+    server.register_api("avax", avax_api)
+    # "import" is a python keyword; the wire name must match
+    # service.go's avax.import
+    server.register("avax", "import", avax_api._import_impl)
     server.register_api("admin", AdminAPI(vm))
     server.register("health", "check", lambda: health_check(vm))
 

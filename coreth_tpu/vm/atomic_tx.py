@@ -272,7 +272,11 @@ class ExportTx:
                 raise AtomicTxError("export input signature mismatch")
 
     def evm_state_transfer(self, vm, state) -> None:
-        """Debit inputs with nonce check (export_tx.go:372)."""
+        """Debit inputs with nonce check (export_tx.go:372-401). Multiple
+        inputs from one address carry the SAME nonce (e.g. asset + AVAX
+        fee); the nonce bumps once per address after all checks, exactly
+        as the reference's addrs map does (export_tx.go:393-400)."""
+        addr_nonce: Dict[bytes, int] = {}
         for inp in self.ins:
             if inp.asset_id == vm.avax_asset_id:
                 amount_wei = inp.amount * X2C_RATE
@@ -287,7 +291,9 @@ class ExportTx:
                 raise AtomicTxError(
                     f"invalid export nonce: state {state.get_nonce(inp.address)} != tx {inp.nonce}"
                 )
-            state.set_nonce(inp.address, inp.nonce + 1)
+            addr_nonce[inp.address] = inp.nonce
+        for addr, nonce in addr_nonce.items():
+            state.set_nonce(addr, nonce + 1)
 
     def atomic_ops(self) -> Tuple[bytes, Requests]:
         """Produce UTXOs into [destination_chain]'s namespace."""

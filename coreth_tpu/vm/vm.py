@@ -117,6 +117,16 @@ class VM:
         self.atomic_codec = None
         self.to_engine = to_engine  # callable: notify engine txs are ready
 
+        # node keystore (node/ keystore dir role; backs avax.importKey/
+        # exportKey/import/export and the eth/personal signing RPC)
+        ks_dir = getattr(self.full_config, "keystore_directory", "")
+        if ks_dir:
+            from ..accounts.keystore import KeyStore
+
+            self.keystore = KeyStore(ks_dir)
+        else:
+            self.keystore = None
+
         clock = self.config.clock or (lambda: self._now())
 
         cb = ConsensusCallbacks(

@@ -19,12 +19,18 @@ import random
 import sys
 import time
 
-os.environ.setdefault(
-    "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+assert len(jax.devices()) >= 8, (
+    f"need 8 virtual devices, have {len(jax.devices())} — the recorded "
+    "artifact must reflect a genuinely sharded run")
 
 import numpy as np  # noqa: E402
 
