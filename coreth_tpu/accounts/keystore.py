@@ -122,6 +122,7 @@ class KeyStore:
         self.light = light
         self.lock = threading.Lock()
         self._unlocked: Dict[bytes, bytes] = {}  # address -> priv
+        self._relock: Dict[bytes, threading.Timer] = {}
         os.makedirs(keydir, exist_ok=True)
 
     # --- account management ----------------------------------------------
@@ -176,17 +177,29 @@ class KeyStore:
 
     def unlock(self, address: bytes, password: str,
                timeout: Optional[float] = None) -> None:
+        """Unlock; timeout=None means until lock_account. A new unlock
+        REPLACES any pending relock timer (keystore.go TimedUnlock drops
+        the previous timer), so an indefinite unlock isn't cut short by an
+        earlier timed one and repeated unlocks extend the window."""
         priv = self.export_key(address, password)
         with self.lock:
             self._unlocked[address] = priv
-        if timeout:
-            t = threading.Timer(timeout, lambda: self.lock_account(address))
-            t.daemon = True
-            t.start()
+            old = self._relock.pop(address, None)
+            if old is not None:
+                old.cancel()
+            if timeout:
+                t = threading.Timer(
+                    timeout, lambda: self.lock_account(address))
+                t.daemon = True
+                self._relock[address] = t
+                t.start()
 
     def lock_account(self, address: bytes) -> None:
         with self.lock:
             self._unlocked.pop(address, None)
+            old = self._relock.pop(address, None)
+            if old is not None:
+                old.cancel()
 
     def sign_hash(self, address: bytes, digest: bytes) -> bytes:
         with self.lock:

@@ -162,8 +162,6 @@ class EthBackend:
                     -32602,
                     f"maxFeePerGas ({max_fee}) < maxPriorityFeePerGas "
                     f"({tip})")
-            if not obj.get("maxPriorityFeePerGas"):
-                tip = min(tip, max_fee)
             tx = Transaction(
                 type=2, chain_id=self.chain_config.chain_id, nonce=nonce,
                 max_fee=max_fee, max_priority_fee=tip, gas_price=max_fee,

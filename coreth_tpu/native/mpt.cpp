@@ -27,6 +27,8 @@
 #include <cstring>
 #include <mutex>
 #include <thread>
+#include <atomic>
+#include <cstdlib>
 #include <memory>
 #include <vector>
 #include <array>
@@ -188,7 +190,8 @@ inline void write_compact(const uint8_t* key32, int from, int to, bool term,
 // Build -------------------------------------------------------------------
 
 struct Builder {
-  Plan& p;
+  const Plan& p;
+  std::vector<Node>& nodes;  // output arena (Plan's, or a thread-local)
 
   // returns node id; fills enc_len/height
   int32_t build(int64_t lo, int64_t hi, int depth) {
@@ -208,8 +211,8 @@ struct Builder {
       const uint8_t* v = p.vals_p + p.val_off_p[lo];
       int payload = key_enc + bytes_enc_len(v, vlen);
       nd.enc_len = list_hdr_len(payload) + payload;
-      p.nodes.push_back(nd);
-      return (int32_t)p.nodes.size() - 1;
+      nodes.push_back(nd);
+      return (int32_t)nodes.size() - 1;
     }
     // longest common prefix from depth between first and last key
     const uint8_t* kl = p.keys_p + (hi - 1) * 32;
@@ -222,7 +225,7 @@ struct Builder {
       nd.nib_end = lcp;
       nd.key_idx = lo;
       nd.child[0] = child;
-      Node& c = p.nodes[child];
+      Node& c = nodes[child];
       nd.height = (uint8_t)(c.height + 1);
       uint8_t tmp[34];
       int clen = compact_len(lcp - depth);
@@ -230,8 +233,8 @@ struct Builder {
       int child_ref = c.enc_len < 32 ? c.enc_len : 33;
       int payload = bytes_enc_len(tmp, clen) + child_ref;
       nd.enc_len = list_hdr_len(payload) + payload;
-      p.nodes.push_back(nd);
-      return (int32_t)p.nodes.size() - 1;
+      nodes.push_back(nd);
+      return (int32_t)nodes.size() - 1;
     }
     // branch at `depth`
     Node nd{};
@@ -248,7 +251,7 @@ struct Builder {
       while (e < hi && nibble(p.keys_p + e * 32, depth) == nb) ++e;
       int32_t child = build(s, e, depth + 1);
       nd.child[nb] = child;
-      Node& c = p.nodes[child];
+      Node& c = nodes[child];
       payload += c.enc_len < 32 ? c.enc_len : 33;
       hmax = std::max(hmax, (int)c.height);
       s = e;
@@ -260,10 +263,115 @@ struct Builder {
     payload += 16 - present;
     nd.height = (uint8_t)(hmax + 1);
     nd.enc_len = list_hdr_len(payload) + payload;
-    p.nodes.push_back(nd);
-    return (int32_t)p.nodes.size() - 1;
+    nodes.push_back(nd);
+    return (int32_t)nodes.size() - 1;
   }
 };
+
+// Parallel tree build: the root's first-nibble subtrees are independent
+// (sorted keys partition cleanly), so each builds into a thread-local
+// arena; the merge appends arenas in nibble order with an O(n) child-index
+// fixup and assembles the root branch. Falls back to the serial recursion
+// when the root is not a branch (a shared first-nibble prefix — improbable
+// for keccak-hashed keys) or the workload is small. Thread count:
+// CORETH_TPU_PLAN_THREADS overrides hardware_concurrency (the sweep knob
+// for PERF.md's scaling record).
+
+int plan_threads() {
+  const char* e = std::getenv("CORETH_TPU_PLAN_THREADS");
+  if (e && *e) return std::max(1, std::atoi(e));
+  return (int)std::max(1u, std::thread::hardware_concurrency());
+}
+
+// instrumentation for the thread-sweep record: parts built, threads used,
+// slowest part (the wall-clock bound on real cores), total part CPU
+thread_local double g_build_stats[4];
+
+int32_t build_tree(Plan& p) {
+  int threads = plan_threads();
+  g_build_stats[0] = 0;
+  g_build_stats[1] = 1;
+  g_build_stats[2] = g_build_stats[3] = 0.0;
+  const uint8_t* k0 = p.keys_p;
+  const uint8_t* kl = p.keys_p + (p.n - 1) * 32;
+  if (threads <= 1 || p.n < 4096 || lcp_nibbles(k0, kl, 0) > 0) {
+    Builder b{p, p.nodes};
+    return b.build(0, p.n, 0);
+  }
+
+  struct Part {
+    int nb;
+    int64_t lo, hi;
+    std::vector<Node> nodes;
+    int32_t local_root = -1;
+    double wall = 0.0;
+  };
+  std::vector<Part> parts;
+  int64_t s = 0;
+  while (s < p.n) {
+    int nb = nibble(p.keys_p + s * 32, 0);
+    int64_t e = s + 1;
+    while (e < p.n && nibble(p.keys_p + e * 32, 0) == nb) ++e;
+    parts.push_back({nb, s, e});
+    s = e;
+  }
+
+  int t = std::min<int>(threads, (int)parts.size());
+  std::atomic<size_t> next{0};
+  auto worker = [&]() {
+    for (;;) {
+      size_t i = next.fetch_add(1);
+      if (i >= parts.size()) return;
+      Part& part = parts[i];
+      double t0 = now_s();
+      part.nodes.reserve((size_t)((part.hi - part.lo) * 15 / 10) + 16);
+      Builder b{p, part.nodes};
+      part.local_root = b.build(part.lo, part.hi, 1);
+      part.wall = now_s() - t0;
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int i = 0; i < t; ++i) pool.emplace_back(worker);
+  for (auto& th : pool) th.join();
+
+  // merge arenas in nibble order; child ids shift by each arena's base
+  size_t total = 1;  // + root
+  for (auto& part : parts) total += part.nodes.size();
+  p.nodes.reserve(total);
+  Node root{};
+  root.kind = 2;
+  root.depth = 0;
+  root.key_idx = 0;
+  for (int i = 0; i < 16; ++i) root.child[i] = -1;
+  int payload = 1;
+  int hmax = -1;
+  for (auto& part : parts) {
+    int32_t base = (int32_t)p.nodes.size();
+    for (Node nd : part.nodes) {
+      if (nd.kind == 1) {
+        if (nd.child[0] >= 0) nd.child[0] += base;
+      } else if (nd.kind == 2) {
+        for (int i = 0; i < 16; ++i)
+          if (nd.child[i] >= 0) nd.child[i] += base;
+      }
+      p.nodes.push_back(nd);
+    }
+    int32_t groot = part.local_root + base;
+    root.child[part.nb] = groot;
+    const Node& c = p.nodes[groot];
+    payload += c.enc_len < 32 ? c.enc_len : 33;
+    hmax = std::max(hmax, (int)c.height);
+    g_build_stats[2] = std::max(g_build_stats[2], part.wall);
+    g_build_stats[3] += part.wall;
+  }
+  payload += 16 - (int)parts.size();
+  root.height = (uint8_t)(hmax + 1);
+  root.enc_len = list_hdr_len(payload) + payload;
+  p.nodes.push_back(root);
+  g_build_stats[0] = (double)parts.size();
+  g_build_stats[1] = (double)t;
+  return (int32_t)p.nodes.size() - 1;
+}
 
 // Segment assignment: group hashed nodes by (height level, exact block
 // count). Lane counts pad to a power of two up to 8192 and to multiples of
@@ -417,7 +525,7 @@ void layout(Plan& p) {
   // (each thread keeps a local patch list, merged back in lane order so
   // the exported tables stay deterministic)
   p.total_patches = 0;
-  int hw = std::max(1u, std::thread::hardware_concurrency());
+  int hw = plan_threads();
   for (auto& seg : p.segs) {
     int width = seg.blocks * kRate;
     seg.pl.clear();
@@ -502,8 +610,7 @@ static Plan* plan_core(Plan* p, uint64_t n) {
   p->n = (int64_t)n;
   p->nodes.reserve((size_t)(n * 15 / 10) + 16);
   double t0 = now_s();
-  Builder b{*p};
-  p->root_id = b.build(0, (int64_t)n, 0);
+  p->root_id = build_tree(*p);
   g_timings[0] = now_s() - t0;
   layout(*p);
   return p;
@@ -543,6 +650,16 @@ void* mpt_plan_borrowed(const uint8_t* keys, const uint8_t* vals,
   p->vals_p = vals;
   p->val_off_p = val_off;
   return plan_core(p, n);
+}
+
+// parallel-build stats of the LAST mpt_plan on this thread:
+// [parts, threads_used, max_part_wall_s, sum_part_wall_s] — max_part is
+// the wall-clock bound on a machine with >= threads real cores
+void mpt_plan_build_stats(double* out4) {
+  out4[0] = g_build_stats[0];
+  out4[1] = g_build_stats[1];
+  out4[2] = g_build_stats[2];
+  out4[3] = g_build_stats[3];
 }
 
 // phase timings of the LAST mpt_plan on this thread: [build, alloc, rows]

@@ -60,6 +60,11 @@ class RPCServer:
             if callable(fn):
                 self.register(namespace, attr, fn)
 
+    def unregister(self, namespace: str, name: str) -> None:
+        """Remove one method — API gating carve-outs (the reference's
+        eth-apis list gates at sub-namespace granularity, vm.go:1140)."""
+        self._methods.pop(f"{namespace}_{name}", None)
+
     def register_subscription(self, namespace: str, name: str,
                               factory: Callable) -> None:
         """factory(notify_fn, *params) -> cleanup_fn|None."""

