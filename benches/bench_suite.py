@@ -426,6 +426,25 @@ def bench_8():
     _emit(8, "log_filter_logs_per_sec", total / best, "logs/s", 1.0)
 
 
+def bench_9():
+    """Device-resident pipelined commits (bench.py's resident leg:
+    deferred absorb + template residency — the round-4 design)."""
+    from bench import PhaseWatchdog, run_resident
+
+    wd = PhaseWatchdog(time.monotonic() + 1800)
+    out = run_resident(wd)
+    wd.cancel()
+    if "res_tpu_nodes_per_sec" in out:
+        _emit(9, "resident_commit_nodes_per_sec",
+              out["res_tpu_nodes_per_sec"], "nodes/s", out["res_vs_cpu"])
+        print(json.dumps({"config": 9, **{
+            k: v for k, v in out.items()
+            if k.startswith("res_h2d") or k.startswith("res_modeled")
+        }}), flush=True)
+    else:
+        print(json.dumps({"config": 9, **out}), flush=True)
+
+
 def main():
     from coreth_tpu.utils import enable_compilation_cache
 
@@ -443,12 +462,12 @@ def main():
     watchdog = PhaseWatchdog(
         time.monotonic() + float(os.environ.get("CORETH_TPU_BENCH_WATCHDOG",
                                                 "1800")))
-    picks = [int(a) for a in sys.argv[1:]] or [1, 2, 3, 4, 5, 6, 7, 8]
+    picks = [int(a) for a in sys.argv[1:]] or [1, 2, 3, 4, 5, 6, 7, 8, 9]
     for i in picks:
-        # config 7 runs bench.py's incremental leg under its own phase
-        # watchdog with larger budgets (900s cold warmup); the outer arm
-        # must not undercut it
-        watchdog.arm(f"config-{i}", 1500 if i == 7 else 600)
+        # configs 7/9 run bench.py legs under their own phase watchdogs
+        # with larger budgets (900s cold warmup); the outer arm must not
+        # undercut them
+        watchdog.arm(f"config-{i}", 1500 if i in (7, 9) else 600)
         globals()[f"bench_{i}"]()
     watchdog.cancel()
 

@@ -123,6 +123,7 @@ class KeyStore:
         self.lock = threading.Lock()
         self._unlocked: Dict[bytes, bytes] = {}  # address -> priv
         self._relock: Dict[bytes, threading.Timer] = {}
+        self._unlock_seq: Dict[bytes, int] = {}  # stale-timer fence
         os.makedirs(keydir, exist_ok=True)
 
     # --- account management ----------------------------------------------
@@ -184,19 +185,32 @@ class KeyStore:
         priv = self.export_key(address, password)
         with self.lock:
             self._unlocked[address] = priv
+            # bump the fence FIRST: a timer that already fired and is
+            # waiting on self.lock sees a stale seq and becomes a no-op
+            # (keystore.go expire() checks unlock identity the same way)
+            seq = self._unlock_seq.get(address, 0) + 1
+            self._unlock_seq[address] = seq
             old = self._relock.pop(address, None)
             if old is not None:
                 old.cancel()
             if timeout:
                 t = threading.Timer(
-                    timeout, lambda: self.lock_account(address))
+                    timeout, lambda: self._timed_lock(address, seq))
                 t.daemon = True
                 self._relock[address] = t
                 t.start()
 
+    def _timed_lock(self, address: bytes, seq: int) -> None:
+        with self.lock:
+            if self._unlock_seq.get(address) != seq:
+                return  # superseded by a newer unlock
+            self._unlocked.pop(address, None)
+            self._relock.pop(address, None)
+
     def lock_account(self, address: bytes) -> None:
         with self.lock:
             self._unlocked.pop(address, None)
+            self._unlock_seq[address] = self._unlock_seq.get(address, 0) + 1
             old = self._relock.pop(address, None)
             if old is not None:
                 old.cancel()

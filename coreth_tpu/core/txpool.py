@@ -447,6 +447,18 @@ class TxPool:
     def has(self, tx_hash: bytes) -> bool:
         return tx_hash in self.all
 
+    # fork-scheduled floors (gasprice_update.go gasPriceSetter):
+    # SetGasPrice -> the admission tip floor; SetMinFee -> the fee-cap
+    # floor (head events re-derive min_fee from the base fee thereafter)
+
+    def set_price_floor(self, price: int) -> None:
+        with self.mu:
+            self.config.price_limit = price
+
+    def set_min_fee_floor(self, fee: Optional[int]) -> None:
+        with self.mu:
+            self.min_fee = fee
+
     def nonce(self, addr: bytes) -> int:
         with self.mu:
             return self.pending_nonces.get(addr, self.statedb.get_nonce(addr))

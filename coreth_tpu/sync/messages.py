@@ -20,9 +20,13 @@ TYPE_CODE_REQUEST = 4
 TYPE_CODE_RESPONSE = 5
 TYPE_TX_GOSSIP = 6
 TYPE_ATOMIC_TX_GOSSIP = 7
+TYPE_ETH_CALL_REQUEST = 8
+TYPE_ETH_CALL_RESPONSE = 9
 
 MAX_LEAVES_LIMIT = 1024  # sync/handlers/leafs_request.go:34
 MAX_CODE_HASHES_PER_REQUEST = 5
+
+
 
 
 def _u(b) -> int:
@@ -153,6 +157,42 @@ class SyncSummary:
         return keccak256(self.encode())
 
 
+@dataclass
+class EthCallRequest:
+    """Cross-chain eth_call (message/eth_call_request.go + the typed
+    cross-chain capability of peer/network.go:199-301): request_args is
+    the UTF-8 JSON call object exactly as eth_call takes it."""
+
+    request_args: bytes
+
+    def encode(self) -> bytes:
+        return bytes([TYPE_ETH_CALL_REQUEST]) + rlp.encode(
+            [self.request_args])
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "EthCallRequest":
+        items = rlp.decode(payload)
+        return cls(request_args=bytes(items[0]))
+
+
+@dataclass
+class EthCallResponse:
+    """result: 0x-hex return data; error: empty when the call succeeded
+    (reverts surface as error + the revert data in result)."""
+
+    result: bytes
+    error: bytes = b""
+
+    def encode(self) -> bytes:
+        return bytes([TYPE_ETH_CALL_RESPONSE]) + rlp.encode(
+            [self.result, self.error])
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "EthCallResponse":
+        items = rlp.decode(payload)
+        return cls(result=bytes(items[0]), error=bytes(items[1]))
+
+
 def decode_message(blob: bytes):
     """Dispatch on the type tag."""
     tag, payload = blob[0], blob[1:]
@@ -163,6 +203,8 @@ def decode_message(blob: bytes):
         TYPE_BLOCK_RESPONSE: BlockResponse,
         TYPE_CODE_REQUEST: CodeRequest,
         TYPE_CODE_RESPONSE: CodeResponse,
+        TYPE_ETH_CALL_REQUEST: EthCallRequest,
+        TYPE_ETH_CALL_RESPONSE: EthCallResponse,
     }.get(tag)
     if codec is None:
         raise ValueError(f"unknown message type {tag}")

@@ -101,6 +101,11 @@ class Config:
     max_outbound_active_requests: int = 16
     max_outbound_active_cross_chain_requests: int = 64
 
+    # which field names the Initialize JSON blob set explicitly (filled by
+    # parse_config) — process-global settings (log level, expensive
+    # metrics) are only applied when the operator actually asked
+    explicit_keys: set = field(default_factory=set)
+
     def validate(self) -> None:
         """config.go Validate."""
         if self.populate_missing_tries is not None and (
@@ -126,6 +131,7 @@ def parse_config(config_bytes: bytes) -> Config:
     """Decode the Initialize JSON blob, applying defaults for absent keys
     (vm.go:326-334). JSON keys are the reference's kebab-case names."""
     cfg = Config()
+    cfg.explicit_keys = set()
     if not config_bytes:
         return cfg
     raw = json.loads(config_bytes)
@@ -135,5 +141,6 @@ def parse_config(config_bytes: bytes) -> Config:
         if attr is None:
             continue  # unknown keys are ignored like the reference
         setattr(cfg, attr, v)
+        cfg.explicit_keys.add(attr)
     cfg.validate()
     return cfg

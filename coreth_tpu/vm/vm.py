@@ -118,12 +118,19 @@ class VM:
         self.to_engine = to_engine  # callable: notify engine txs are ready
 
         # honor global observability knobs (vm.go:344-353 log config;
-        # metrics.EnabledExpensive gate)
-        from .. import log as _log
-        from .. import metrics as _metrics
+        # metrics.EnabledExpensive gate) — ONLY when the blob set them:
+        # these are process-global, and a second VM in the same process
+        # must not silently reset the first one's diagnostics
+        explicit = getattr(self.full_config, "explicit_keys", set())
+        if "log_level" in explicit:
+            from .. import log as _log
 
-        _log.set_level(self.full_config.log_level)
-        _metrics.enabled_expensive = self.full_config.metrics_expensive_enabled
+            _log.set_level(self.full_config.log_level)
+        if "metrics_expensive_enabled" in explicit:
+            from .. import metrics as _metrics
+
+            _metrics.enabled_expensive = (
+                self.full_config.metrics_expensive_enabled)
 
         # node keystore (node/ keystore dir role; backs avax.importKey/
         # exportKey/import/export and the eth/personal signing RPC)
@@ -179,6 +186,16 @@ class VM:
             self.chain_config, self.engine, self.blockchain,
             tx_pool=self.txpool, clock=clock,
         )
+
+        # fork-scheduled gas-price floors (vm.go handleGasPriceUpdates).
+        # Wall clock on purpose: fork timestamps are wall times and the
+        # reference schedules with time.Until — the VM's block-timestamp
+        # clock override must not skew the schedule.
+        from .plumbing import GasPriceUpdater
+
+        self.gas_price_updater = GasPriceUpdater(
+            self.txpool, self.chain_config)
+        self.gas_price_updater.start()
 
         def price(tx: Tx) -> int:
             gas = max(tx.gas_used(self.current_rules().is_apricot_phase5), 1)
@@ -404,6 +421,7 @@ class VM:
     def shutdown(self) -> None:
         if self.initialized:
             self.block_builder.shutdown()
+            self.gas_price_updater.stop()
             if self.continuous_profiler is not None:
                 self.continuous_profiler.stop()
             self.blockchain.stop()
@@ -433,6 +451,55 @@ class VM:
         self.atomic_trie.index(vmb.height(), {chain: requests})
 
     # --- atomic tx issuance (vm.go:1297-1417) -----------------------------
+
+    # --- cross-chain eth_call capability (peer/network.go:199-301 +
+    # message/eth_call_request.go): another chain's VM evaluates a
+    # read-only call against OUR latest accepted state ------------------
+
+    def handle_cross_chain_request(self, blob: bytes) -> bytes:
+        """Typed cross-chain dispatcher: register with
+        Network.register_cross_chain_handler(vm.chain_id_bytes, ...)."""
+        import json as _json
+
+        from ..sync.messages import (EthCallRequest, EthCallResponse,
+                                     decode_message)
+
+        msg = decode_message(blob)
+        if not isinstance(msg, EthCallRequest):
+            raise VMError(f"unsupported cross-chain request {type(msg)}")
+        backend = getattr(self, "eth_backend", None)
+        if backend is None:
+            from ..eth.backend import EthBackend
+
+            backend = EthBackend(self.blockchain, self.txpool)
+            self.eth_backend = backend
+        try:
+            call_obj = _json.loads(msg.request_args.decode())
+            result = backend.do_call(call_obj, "latest")
+        except Exception as e:  # noqa: BLE001 — errors travel in-band
+            return EthCallResponse(result=b"", error=str(e).encode()).encode()
+        if result.err is not None:
+            return EthCallResponse(result=result.return_data,
+                                   error=str(result.err).encode()).encode()
+        return EthCallResponse(result=result.return_data).encode()
+
+    def cross_chain_eth_call(self, network, chain_id: bytes,
+                             call_obj: dict, deadline: float = 10.0):
+        """Client side: eth_call on [chain_id]'s VM over the cross-chain
+        transport. Returns the raw return data; raises VMError with the
+        remote error string on failure."""
+        import json as _json
+
+        from ..sync.messages import EthCallRequest, decode_message
+
+        req = EthCallRequest(
+            request_args=_json.dumps(call_obj).encode()).encode()
+        resp = decode_message(
+            network.send_cross_chain_request(chain_id, req, deadline))
+        if resp.error:
+            raise VMError(
+                f"cross-chain eth_call failed: {resp.error.decode()}")
+        return resp.result
 
     def issue_atomic_tx(self, tx: Tx) -> None:
         tx.semantic_verify(self, self._next_base_fee())

@@ -100,25 +100,34 @@ def test_allow_unfinalized_queries_knob():
 
 
 def test_txpool_limits_honored():
+    from coreth_tpu.core.txpool import TxPool, TxPoolConfig
     from coreth_tpu.core.types import Signer, Transaction
 
     vm = boot_vm(**{"tx-pool-account-slots": 2, "tx-pool-price-limit": 5,
                     "tx-pool-global-slots": 77, "tx-pool-account-queue": 9})
+    # the limit knobs all land in the live pool's config...
+    assert vm.txpool.config.account_slots == 2
+    assert vm.txpool.config.global_slots == 77
+    assert vm.txpool.config.account_queue == 9
+    # ...but on this all-forks config the admission floor is the fork
+    # schedule's (GasPriceUpdater zeroes the price floor at AP3 and the
+    # AP4 min-fee floor takes over — reference gasprice_update.go), so
+    # the knob's own admission effect is observed on a pool WITHOUT the
+    # updater attached:
+    pool = TxPool(TxPoolConfig(price_limit=5), vm.chain_config,
+                  vm.blockchain)
     signer = Signer(43112)
-    # price-limit is enforced at admission: below 5 wei -> underpriced
     cheap = signer.sign(Transaction(
         type=0, chain_id=43112, nonce=0, gas_price=1, gas=21000,
         to=b"\x01" * 20, value=1), KEY)
     with pytest.raises(Exception, match="underpriced"):
-        vm.txpool.add_remote(cheap)
-    ok = signer.sign(Transaction(
+        pool.add_remote(cheap)
+    # and the fork floor is what rejects on the VM's own pool
+    mid = signer.sign(Transaction(
         type=0, chain_id=43112, nonce=0, gas_price=10**10, gas=21000,
         to=b"\x01" * 20, value=1), KEY)
-    vm.txpool.add_remote(ok)
-    # the limit knobs all land in the live pool's config
-    assert vm.txpool.config.account_slots == 2
-    assert vm.txpool.config.global_slots == 77
-    assert vm.txpool.config.account_queue == 9
+    with pytest.raises(Exception, match="below minimum"):
+        vm.txpool.add_remote(mid)  # 10 gwei < AP4 25 gwei min fee
     vm.shutdown()
 
 

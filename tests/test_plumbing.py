@@ -1,0 +1,79 @@
+"""VM plumbing: fork-scheduled gas-price floors, static genesis service,
+ext-data-hash repair tables, factory (plugin/evm/{gasprice_update,
+static_service,ext_data_hashes,factory}.go)."""
+
+import json
+import time
+
+from coreth_tpu import params
+from coreth_tpu.vm.plumbing import (
+    GasPriceUpdater,
+    StaticService,
+    factory_new,
+    load_ext_data_hashes,
+    repaired_ext_data_hash,
+)
+
+
+class FakePool:
+    def __init__(self):
+        self.price = None
+        self.min_fee = None
+
+    def set_price_floor(self, p):
+        self.price = p
+
+    def set_min_fee_floor(self, f):
+        self.min_fee = f
+
+
+def test_gas_price_updater_past_forks_apply_immediately():
+    pool = FakePool()
+    cfg = params.TEST_CHAIN_CONFIG  # all forks active at t=0
+    gpu = GasPriceUpdater(pool, cfg, clock=lambda: 10**9)
+    gpu.start()
+    # AP3 zeroes the gas price floor; AP4 sets the final min fee
+    assert pool.price == 0
+    assert pool.min_fee == params.APRICOT_PHASE4_MIN_BASE_FEE
+    gpu.stop()
+
+
+def test_gas_price_updater_future_fork_scheduled():
+    import dataclasses
+
+    pool = FakePool()
+    now = time.time()
+    cfg = dataclasses.replace(
+        params.TEST_CHAIN_CONFIG,
+        apricot_phase1_time=int(now) + 3600,
+        apricot_phase3_time=None, apricot_phase4_time=None)
+    gpu = GasPriceUpdater(pool, cfg)
+    gpu.start()
+    # launch floor applied now; AP1 waits on a timer
+    assert pool.price == params.LAUNCH_MIN_GAS_PRICE
+    assert len(gpu._timers) == 1
+    gpu.stop()
+    assert not gpu._timers
+
+
+def test_static_service_build_genesis_roundtrip():
+    svc = StaticService()
+    spec = {"config": {"chainId": 43112}, "alloc": {}}
+    out = svc.buildGenesis(spec)
+    assert out["encoding"] == "hex"
+    assert json.loads(bytes.fromhex(out["bytes"][2:])) == spec
+
+
+def test_ext_data_hash_repair_table():
+    h = "0x" + "ab" * 32
+    repaired = "0x" + "cd" * 32
+    load_ext_data_hashes(5, json.dumps({h: repaired}).encode())
+    assert repaired_ext_data_hash(5, bytes.fromhex("ab" * 32)) == \
+        bytes.fromhex("cd" * 32)
+    assert repaired_ext_data_hash(5, b"\x00" * 32) is None
+    assert repaired_ext_data_hash(1, bytes.fromhex("ab" * 32)) is None
+
+
+def test_factory_new_returns_uninitialized_vm():
+    vm = factory_new()
+    assert vm.initialized is False
