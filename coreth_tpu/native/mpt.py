@@ -346,6 +346,12 @@ def load_inc():
         ]
         lib.mpt_inc_res_mark_clean.restype = None
         lib.mpt_inc_res_mark_clean.argtypes = [ctypes.c_void_p]
+        lib.mpt_inc_checkpoint.restype = None
+        lib.mpt_inc_checkpoint.argtypes = [ctypes.c_void_p]
+        lib.mpt_inc_discard_checkpoint.restype = None
+        lib.mpt_inc_discard_checkpoint.argtypes = [ctypes.c_void_p]
+        lib.mpt_inc_rollback.restype = ctypes.c_uint64
+        lib.mpt_inc_rollback.argtypes = [ctypes.c_void_p]
         lib.mpt_inc_root.restype = None
         lib.mpt_inc_root.argtypes = [ctypes.c_void_p, _u8p]
         lib.mpt_inc_free.restype = None
@@ -563,6 +569,25 @@ class IncrementalTrie:
         root = executor.run(export)
         self._lib.mpt_inc_res_mark_clean(self._h)
         return root
+
+    # ---- checkpoint / rollback (the chain adapter's verify->reject
+    # enabler: core/blockchain.go:1424 reorg, plugin/evm/block.go:173) ----
+
+    def checkpoint(self) -> None:
+        """Open an undo scope: updates applied until discard_checkpoint()
+        or rollback() journal their previous state."""
+        self._lib.mpt_inc_checkpoint(self._h)
+
+    def discard_checkpoint(self) -> None:
+        """Keep the scope's changes (block accepted); nested scopes merge
+        into their parent."""
+        self._lib.mpt_inc_discard_checkpoint(self._h)
+
+    def rollback(self) -> int:
+        """Revert every update since the last checkpoint (block rejected
+        / reorg); returns the number of ops reverted. Reverted paths are
+        left dirty, so the next commit re-plans them."""
+        return int(self._lib.mpt_inc_rollback(self._h))
 
     def dirty_stats(self):
         """(dirty hashed nodes, mini-plan bytes) of the CURRENT plan —

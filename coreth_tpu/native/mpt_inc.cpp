@@ -162,6 +162,21 @@ struct Inc {
     delete n;
   }
 
+  // ---- undo journal (checkpoint/rollback) ----
+  // One entry per applied update op: the key's PREVIOUS state. Rollback
+  // replays entries in reverse through the normal updater, so the trie
+  // (and its dirty/structural marks) land exactly where a fresh
+  // application of the old values would — the chain adapter's
+  // verify->reject/reorg enabler (core/blockchain.go:1424 reorg,
+  // plugin/evm/block.go:173 Reject).
+  struct Undo {
+    std::vector<uint8_t> key;  // 32B
+    std::vector<uint8_t> old_val;
+    bool had_old;
+  };
+  std::vector<Undo> undo_log;
+  std::vector<size_t> undo_marks;  // checkpoint stack: log sizes
+
   // active mini-plan. flat is allocated UNINITIALIZED — rows are fully
   // written (incl. a padding-tail memset); pad lanes hold garbage whose
   // digests nothing references
@@ -228,10 +243,23 @@ INode* build_range(Inc& t, const uint8_t* keys, const uint8_t* vals,
 struct Updater {
   Inc& t;
   const uint8_t* key;  // 32 bytes, 64 nibbles
+  std::vector<Inc::Undo>* journal = nullptr;  // open checkpoint scope
+
+  // record the key's previous state exactly once per applied op, at the
+  // mutation site (no separate pre-lookup): leaf replace/create/delete
+  void record(const std::vector<uint8_t>* old_val) {
+    if (!journal) return;
+    Inc::Undo u;
+    u.key.assign(key, key + 32);
+    u.had_old = old_val != nullptr;
+    if (old_val) u.old_val = *old_val;
+    journal->push_back(std::move(u));
+  }
 
   // insert/replace; returns (node, changed)
   INode* insert(INode* n, int pos, const uint8_t* v, int vlen, bool& changed) {
     if (!n) {
+      record(nullptr);  // key was absent
       INode* nd = new INode(0);
       nd->nnib = (uint8_t)(64 - pos);
       for (int i = pos; i < 64; ++i) nd->frag[i - pos] = nibble(key, i);
@@ -252,6 +280,7 @@ struct Updater {
             changed = false;
             return n;
           }
+          record(&n->val);
           n->val.assign(v, v + vlen);
           n->dirty = true;
           n->structural = true;  // row bytes = value bytes
@@ -325,6 +354,7 @@ struct Updater {
           changed = false;
           return n;
         }
+      record(&n->val);
       t.release(n);
       --t.n_nodes;
       changed = true;
@@ -881,12 +911,17 @@ void* mpt_inc_new(const uint8_t* keys, const uint8_t* vals,
 
 // Apply a batch of updates; vlen == 0 deletes the key. Keys need not be
 // sorted. Returns the number of keys whose application changed the trie.
+// With an open checkpoint, every APPLIED op journals the key's previous
+// state for rollback.
 uint64_t mpt_inc_update(void* h, const uint8_t* keys, const uint8_t* vals,
                         const uint64_t* val_off, uint64_t n) {
   Inc* t = (Inc*)h;
   uint64_t changed_n = 0;
+  std::vector<Inc::Undo>* journal =
+      t->undo_marks.empty() ? nullptr : &t->undo_log;
   for (uint64_t i = 0; i < n; ++i) {
-    Updater u{*t, keys + i * 32};
+    const uint8_t* key = keys + i * 32;
+    Updater u{*t, key, journal};
     bool changed = false;
     int vlen = (int)(val_off[i + 1] - val_off[i]);
     if (vlen == 0) {
@@ -897,6 +932,48 @@ uint64_t mpt_inc_update(void* h, const uint8_t* keys, const uint8_t* vals,
     if (changed) ++changed_n;
   }
   return changed_n;
+}
+
+// ---- checkpoint / rollback ------------------------------------------------
+
+void mpt_inc_checkpoint(void* h) {
+  Inc* t = (Inc*)h;
+  t->undo_marks.push_back(t->undo_log.size());
+}
+
+// Drop the most recent checkpoint, keeping its changes. Entries merge
+// into the enclosing checkpoint if one remains (nested scopes).
+void mpt_inc_discard_checkpoint(void* h) {
+  Inc* t = (Inc*)h;
+  if (t->undo_marks.empty()) return;
+  t->undo_marks.pop_back();
+  // with an enclosing scope, entries stay — they belong to it now
+  if (t->undo_marks.empty()) t->undo_log.clear();
+}
+
+// Revert every update since the most recent checkpoint (reverse replay
+// through the normal updater, so dirty/structural marks stay coherent
+// for the next plan). Returns the number of ops reverted.
+uint64_t mpt_inc_rollback(void* h) {
+  Inc* t = (Inc*)h;
+  if (t->undo_marks.empty()) return 0;
+  size_t mark = t->undo_marks.back();
+  t->undo_marks.pop_back();
+  uint64_t reverted = 0;
+  for (size_t i = t->undo_log.size(); i > mark; --i) {
+    Inc::Undo& u = t->undo_log[i - 1];
+    Updater up{*t, u.key.data()};  // journal deliberately nullptr
+    bool changed = false;
+    if (u.had_old) {
+      t->root = up.insert(t->root, 0, u.old_val.data(),
+                          (int)u.old_val.size(), changed);
+    } else {
+      t->root = up.erase(t->root, 0, changed);
+    }
+    ++reverted;
+  }
+  t->undo_log.resize(mark);
+  return reverted;
 }
 
 // Build the dirty-subtree mini-plan; returns the number of segments.

@@ -243,3 +243,68 @@ def test_resident_growth_reallocates_store_and_arenas():
         assert _root_bytes(ex, dev.commit_resident(ex)) == cpu.commit_cpu()
     assert _root_bytes(ex, ex.last_root) == \
         _full_rebuild_root(state)
+
+
+def test_checkpoint_rollback_restores_roots():
+    """Undo journal (the chain adapter's verify->reject enabler): apply a
+    'block' under a checkpoint, roll back, and the next commits must
+    produce the same roots as a trie that never saw the block — in BOTH
+    commit modes."""
+    rng = random.Random(21)
+    state = _rand_items(rng, 800)
+    items = sorted(state.items())
+
+    # host mode
+    t = IncrementalTrie(items)
+    base_root = t.commit_cpu()
+    t.checkpoint()
+    batch = [(rng.choice(list(state)), rng.randbytes(50)) for _ in range(80)]
+    batch += [(rng.randbytes(32), rng.randbytes(40)) for _ in range(40)]
+    batch += [(k, b"") for k in rng.sample(list(state), 20)]
+    t.update(batch)
+    assert t.commit_cpu() != base_root
+    assert t.rollback() == len(batch)
+    assert t.commit_cpu() == base_root
+
+    # resident mode: same sequence, device-side state must also recover
+    dev = IncrementalTrie(items)
+    ex = _executor()
+    base_dev = _root_bytes(ex, dev.commit_resident(ex))
+    assert base_dev == base_root
+    dev.checkpoint()
+    dev.update(batch)
+    mid = _root_bytes(ex, dev.commit_resident(ex))
+    assert mid != base_root
+    dev.rollback()
+    assert _root_bytes(ex, dev.commit_resident(ex)) == base_root
+
+
+def test_checkpoint_discard_keeps_changes():
+    rng = random.Random(22)
+    state = _rand_items(rng, 200)
+    t = IncrementalTrie(sorted(state.items()))
+    t.commit_cpu()
+    t.checkpoint()
+    batch = [(rng.randbytes(32), b"v")]
+    t.update(batch)
+    t.discard_checkpoint()
+    assert t.rollback() == 0  # no open scope: nothing reverts
+    state[batch[0][0]] = b"v"
+    assert t.commit_cpu() == _full_rebuild_root(state)
+
+
+def test_nested_checkpoints():
+    rng = random.Random(23)
+    state = _rand_items(rng, 300)
+    t = IncrementalTrie(sorted(state.items()))
+    r0 = t.commit_cpu()
+    t.checkpoint()                      # scope A
+    t.update([(rng.randbytes(32), b"a")])
+    r1 = t.commit_cpu()
+    t.checkpoint()                      # scope B
+    t.update([(rng.randbytes(32), b"b")])
+    assert t.commit_cpu() != r1
+    t.rollback()                        # drop B
+    assert t.commit_cpu() == r1
+    t.rollback()                        # drop A
+    assert t.commit_cpu() == r0
