@@ -342,7 +342,7 @@ def load_inc():
         ]
         lib.mpt_inc_res_tables.restype = None
         lib.mpt_inc_res_tables.argtypes = [
-            ctypes.c_void_p, _i32p, _i32p, _i32p, _i32p, _i32p, _i32p, _i32p,
+            ctypes.c_void_p, _i32p, _i32p, _i32p, _i32p, _i32p,
         ]
         lib.mpt_inc_res_mark_clean.restype = None
         lib.mpt_inc_res_mark_clean.argtypes = [ctypes.c_void_p]
@@ -494,6 +494,10 @@ class IncrementalTrie:
         n_seg = int(lib.mpt_inc_plan_res(h))
         if n_seg == (1 << 64) - 1:
             raise ValueError("node RLP wider than the resident row limit")
+        if n_seg == (1 << 64) - 2:
+            raise ValueError(
+                "resident arena class would exceed the 2GB byte-offset "
+                "range (checked before any allocation)")
         if n_seg == 0:
             return None
         meta = np.empty(7, np.int64)
@@ -506,13 +510,10 @@ class IncrementalTrie:
         lib.mpt_inc_res_cls_counts(h, cls_counts.reshape(-1))
         rowidx = np.empty(total_lanes, np.int32)
         lane_slot = np.empty(total_lanes, np.int32)
-        dstw = np.empty(total_patches, np.int32)
-        digidx = np.empty(total_patches, np.int32)
-        storeidx = np.empty(total_patches, np.int32)
+        off = np.empty(total_patches, np.int32)
+        src = np.empty(total_patches, np.int32)
         oldidx = np.empty(total_patches, np.int32)
-        shift = np.empty(total_patches, np.int32)
-        lib.mpt_inc_res_tables(
-            h, rowidx, lane_slot, dstw, digidx, storeidx, oldidx, shift)
+        lib.mpt_inc_res_tables(h, rowidx, lane_slot, off, src, oldidx)
         fresh = {}
         classes = {}
         for cls in range(1, n_cls):
@@ -534,11 +535,9 @@ class IncrementalTrie:
             "fresh": fresh,
             "rowidx": rowidx,
             "lane_slot": lane_slot,
-            "dstw": dstw,
-            "digidx": digidx,
-            "storeidx": storeidx,
+            "off": off,
+            "src": src,
             "oldidx": oldidx,
-            "shift": shift,
             "total_lanes": total_lanes,
             "store_slots": int(meta[2]),
             "root_lane": int(meta[3]),

@@ -129,7 +129,9 @@ struct Inc {
   std::vector<ResCls> rcls = std::vector<ResCls>(kMaxBlocks + 1);
   std::vector<ResSeg> rsegs;
   std::vector<int32_t> r_rowidx, r_lane_slot;
-  std::vector<int32_t> r_dstw, r_digidx, r_storeidx, r_oldidx, r_shift;
+  // patch tables: byte offset in the arena (device derives word+shift),
+  // signed source (+k: dig row k; -k: store slot k; 0: none), old slot
+  std::vector<int32_t> r_off, r_src, r_oldidx;
   std::vector<INode*> r_embedded_dirty;
   int32_t r_root_lane = -1;
   int64_t r_total_lanes = 0, r_total_patches = 0, r_num_dirty = 0;
@@ -710,7 +712,10 @@ void collect_embedded_res(Inc& t, INode* n) {
     for (int i = 0; i < 16; ++i) collect_embedded_res(t, n->child[i]);
 }
 
-bool build_plan_res(Inc& t) {
+// 0 = ok; 1 = node RLP wider than kMaxBlocks; 2 = an arena class would
+// exceed the int32 byte-offset range (>2GB — beyond what fits in HBM
+// alongside the store and dig buffers anyway)
+int build_plan_res(Inc& t) {
   t.rsegs.clear();
   for (auto& c : t.rcls) {
     c.fresh_rows.clear();
@@ -718,16 +723,14 @@ bool build_plan_res(Inc& t) {
   }
   t.r_rowidx.clear();
   t.r_lane_slot.clear();
-  t.r_dstw.clear();
-  t.r_digidx.clear();
-  t.r_storeidx.clear();
+  t.r_off.clear();
+  t.r_src.clear();
   t.r_oldidx.clear();
-  t.r_shift.clear();
   t.r_embedded_dirty.clear();
   t.r_root_lane = -1;
   t.r_total_lanes = t.r_total_patches = t.r_num_dirty = 0;
   t.r_fresh_bytes = 0;
-  if (!t.root || !t.root->dirty) return true;
+  if (!t.root || !t.root->dirty) return 0;
 
   std::vector<std::vector<INode*>> levels;
   collect(t.root, levels);
@@ -742,7 +745,7 @@ bool build_plan_res(Inc& t) {
       n->lane = -1;
       if (!hashed) continue;
       int blocks = n->enc_len / kRate + 1;
-      if (blocks > kMaxBlocks) return false;  // >8.6KB node RLP unsupported
+      if (blocks > kMaxBlocks) return 1;  // >8.6KB node RLP unsupported
       entries.push_back({{(int)h, blocks}, n});
     }
   std::stable_sort(entries.begin(), entries.end(),
@@ -752,6 +755,15 @@ bool build_plan_res(Inc& t) {
                                 : a.first.blocks < b.first.blocks;
                    });
   t.r_num_dirty = (int64_t)entries.size();
+
+  {
+    int64_t extra[kMaxBlocks + 1] = {};
+    for (auto& e : entries) ++extra[e.first.blocks];
+    for (int b = 1; b <= kMaxBlocks; ++b) {
+      int64_t worst_rows = (int64_t)t.rcls[b].next_row + extra[b];
+      if (worst_rows * b * kRate > 0x7FFFFFFFLL) return 2;
+    }
+  }
 
   // pass 1: segments, lanes, slot/row allocation, fresh-row classification
   int32_t gstart = 0;
@@ -813,7 +825,7 @@ bool build_plan_res(Inc& t) {
   std::vector<ResPatch> patches;
   for (auto& seg : t.rsegs) {
     int width = seg.blocks * kRate;
-    seg.patch_off = (int32_t)t.r_dstw.size();
+    seg.patch_off = (int32_t)t.r_off.size();
     int np = 0;
     for (size_t lane = 0; lane < seg.node_of_lane.size(); ++lane) {
       INode* n = seg.node_of_lane[lane];
@@ -845,10 +857,8 @@ bool build_plan_res(Inc& t) {
         bool cdirty = c->dirty;  // dirty hashed child: digest from dig
         if (!upload && !cdirty) continue;  // resident hole already correct
         int64_t byte_off = (int64_t)n->row * width + pr.off;
-        t.r_dstw.push_back((int32_t)(byte_off >> 2));
-        t.r_shift.push_back((int32_t)(byte_off & 3));
-        t.r_digidx.push_back(cdirty ? c->lane + 1 : 0);
-        t.r_storeidx.push_back(cdirty ? 0 : c->slot);
+        t.r_off.push_back((int32_t)byte_off);  // pre-checked < 2^31
+        t.r_src.push_back(cdirty ? c->lane + 1 : -c->slot);
         // patch-only rows subtract the child's previous digest (the hole
         // currently holds it); fresh rows have zero holes, so old = 0
         t.r_oldidx.push_back(upload ? 0 : c->slot);
@@ -857,16 +867,14 @@ bool build_plan_res(Inc& t) {
     }
     seg.n_patches = np ? pow2_at_least(np, 16) : 0;
     for (int k = np; k < seg.n_patches; ++k) {  // zero-delta pad patches
-      t.r_dstw.push_back(0);
-      t.r_shift.push_back(0);
-      t.r_digidx.push_back(0);
-      t.r_storeidx.push_back(0);
+      t.r_off.push_back(0);
+      t.r_src.push_back(0);
       t.r_oldidx.push_back(0);
     }
     t.r_total_patches += seg.n_patches;
   }
   collect_embedded_res(t, t.root);
-  return true;
+  return 0;
 }
 
 void res_mark_clean(Inc& t) {
@@ -1080,7 +1088,9 @@ void mpt_inc_absorb(void* h, const uint8_t* dig, uint8_t* out_root32) {
 // failure (a node wider than kMaxBlocks rate blocks).
 uint64_t mpt_inc_plan_res(void* h) {
   Inc* t = (Inc*)h;
-  if (!build_plan_res(*t)) return (uint64_t)-1;
+  int err = build_plan_res(*t);
+  if (err == 1) return (uint64_t)-1;  // node too wide
+  if (err == 2) return (uint64_t)-2;  // arena byte-offset range
   return t->rsegs.size();
 }
 
@@ -1130,19 +1140,16 @@ void mpt_inc_res_fresh(void* h, int32_t cls, uint8_t* rows, int32_t* idx) {
 }
 
 void mpt_inc_res_tables(void* h, int32_t* rowidx, int32_t* lane_slot,
-                        int32_t* dstw, int32_t* digidx, int32_t* storeidx,
-                        int32_t* oldidx, int32_t* shift) {
+                        int32_t* off, int32_t* src, int32_t* oldidx) {
   Inc* t = (Inc*)h;
   auto cp = [](const std::vector<int32_t>& v, int32_t* out) {
     if (!v.empty()) std::memcpy(out, v.data(), v.size() * 4);
   };
   cp(t->r_rowidx, rowidx);
   cp(t->r_lane_slot, lane_slot);
-  cp(t->r_dstw, dstw);
-  cp(t->r_digidx, digidx);
-  cp(t->r_storeidx, storeidx);
+  cp(t->r_off, off);
+  cp(t->r_src, src);
   cp(t->r_oldidx, oldidx);
-  cp(t->r_shift, shift);
 }
 
 // After the device program is dispatched: clear dirty/structural flags.

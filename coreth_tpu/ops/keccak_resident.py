@@ -91,23 +91,24 @@ def _make_res_step(seg_impl, donate: bool = True):
         static_argnames=("lanes", "blocks", "npatch"),
         donate_argnums=(0, 2) if donate else (),
     )
-    def step(arena, store, dig, dstw_all, digidx_all, storeidx_all,
-             oldidx_all, shift_all, rowidx_all, meta, seg_i,
+    def step(arena, store, dig, off_all, src_all, oldidx_all,
+             rowidx_all, meta, seg_i,
              *, lanes: int, blocks: int, npatch: int):
         row = jax.lax.dynamic_slice(meta, (seg_i, 0), (1, 3))[0]
         patch_off, lane_off, gstart = row[0], row[1], row[2]
         flat = arena.reshape(-1)
         if npatch:
-            dstw = jax.lax.dynamic_slice(dstw_all, (patch_off,), (npatch,))
-            digidx = jax.lax.dynamic_slice(digidx_all, (patch_off,), (npatch,))
-            storeidx = jax.lax.dynamic_slice(
-                storeidx_all, (patch_off,), (npatch,))
+            off = jax.lax.dynamic_slice(off_all, (patch_off,), (npatch,))
+            src = jax.lax.dynamic_slice(src_all, (patch_off,), (npatch,))
             oldidx = jax.lax.dynamic_slice(oldidx_all, (patch_off,), (npatch,))
-            shift = jax.lax.dynamic_slice(shift_all, (patch_off,), (npatch,))
-            # exactly one of (dig, store) contributes: the other gathers
-            # the pinned-zero row 0, so OR selects without a branch
-            new = dig[digidx] | store[storeidx]          # [P, 8]
-            old = store[oldidx]                          # [P, 8]
+            dstw = off >> 2            # word index + byte shift derived
+            shift = off & 3            # on device (12 B/patch h2d)
+            # signed source: +k = this commit's dig row k, -k = store
+            # slot k, 0 = none (both gathers hit their pinned-zero row 0)
+            new = jnp.where(src[:, None] > 0,
+                            dig[jnp.maximum(src, 0)],
+                            store[jnp.maximum(-src, 0)])  # [P, 8]
+            old = store[oldidx]                           # [P, 8]
             delta = _strips(new, shift) - _strips(old, shift)
             idx = dstw[:, None] + jnp.arange(9, dtype=jnp.int32)[None, :]
             flat = flat.at[idx.reshape(-1)].add(delta.reshape(-1),
@@ -214,14 +215,14 @@ class ResidentExecutor:
         for i, s in enumerate(specs):
             meta[i] = (s[4], s[5], s[2])   # patch_off, lane_off, gstart
         tables = [jax.device_put(export[k]) for k in
-                  ("dstw", "digidx", "storeidx", "oldidx", "shift", "rowidx")]
+                  ("off", "src", "oldidx", "rowidx")]
         h2d += sum(export[k].nbytes for k in
-                   ("dstw", "digidx", "storeidx", "oldidx", "shift", "rowidx"))
+                   ("off", "src", "oldidx", "rowidx"))
         lane_slot = jax.device_put(export["lane_slot"])
         h2d += export["lane_slot"].nbytes
         mt = jax.device_put(meta)
         seg_ids = jax.device_put(np.arange(MAX_SEGMENTS, dtype=np.int32))
-        dstw, digidx, storeidx, oldidx, shift, rowidx = tables
+        off, src, oldidx, rowidx = tables
 
         total_lanes = int(export["total_lanes"])
         dig = jnp.zeros((1 + total_lanes, 8), jnp.uint32)
@@ -230,7 +231,7 @@ class ResidentExecutor:
             blocks, lanes = int(s[0]), int(s[1])
             arena = self.arenas[blocks]
             arena, dig = self._step(
-                arena, store, dig, dstw, digidx, storeidx, oldidx, shift,
+                arena, store, dig, off, src, oldidx,
                 rowidx, mt, seg_ids[i],
                 lanes=lanes, blocks=blocks, npatch=int(s[3]))
             self.arenas[blocks] = arena
