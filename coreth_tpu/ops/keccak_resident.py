@@ -224,8 +224,18 @@ class ResidentExecutor:
         seg_ids = jax.device_put(np.arange(MAX_SEGMENTS, dtype=np.int32))
         off, src, oldidx, rowidx = tables
 
+        # bucket the dig height to a power of two: every jitted step is
+        # shape-keyed on dig, so an exact per-commit lane total would
+        # recompile each program for every distinct commit size
         total_lanes = int(export["total_lanes"])
-        dig = jnp.zeros((1 + total_lanes, 8), jnp.uint32)
+        g_pad = 16
+        while g_pad < total_lanes:
+            g_pad <<= 1
+        if g_pad != lane_slot.shape[0]:
+            lane_slot = jnp.concatenate([
+                lane_slot,
+                jnp.ones(g_pad - lane_slot.shape[0], jnp.int32)])  # scratch
+        dig = jnp.zeros((1 + g_pad, 8), jnp.uint32)
         store = self.store
         for i, s in enumerate(specs):
             blocks, lanes = int(s[0]), int(s[1])

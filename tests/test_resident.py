@@ -308,3 +308,58 @@ def test_nested_checkpoints():
     assert t.commit_cpu() == r1
     t.rollback()                        # drop A
     assert t.commit_cpu() == r0
+
+
+def test_resident_lifecycle_fuzz():
+    """Randomized end-to-end: interleaved updates, commits, checkpoints,
+    rollbacks, and discards — the resident mirror must track a plain dict
+    (verified via the full-rebuild oracle) through every commit."""
+    rng = random.Random(31)
+    state = _rand_items(rng, 600)
+    dev = IncrementalTrie(sorted(state.items()))
+    ex = _executor()
+    assert _root_bytes(ex, dev.commit_resident(ex)) == \
+        _full_rebuild_root(state)
+
+    keys = list(state)
+    # stack of state snapshots mirroring the trie's checkpoint stack
+    snapshots = []
+    for step in range(60):
+        op = rng.random()
+        if op < 0.5:  # update batch
+            batch = []
+            for _ in range(rng.randint(1, 40)):
+                r = rng.random()
+                if r < 0.4 and keys:
+                    batch.append((rng.choice(keys), rng.randbytes(
+                        rng.randint(1, 90))))
+                elif r < 0.75:
+                    k = rng.randbytes(32)
+                    keys.append(k)
+                    batch.append((k, rng.randbytes(40)))
+                elif keys:
+                    batch.append((rng.choice(keys), b""))
+            dev.update(batch)
+            for k, v in batch:
+                if v:
+                    state[k] = v
+                else:
+                    state.pop(k, None)
+        elif op < 0.65:
+            dev.checkpoint()
+            snapshots.append(dict(state))
+        elif op < 0.8 and snapshots:
+            dev.rollback()
+            state = snapshots.pop()
+            keys = list(state)
+        elif snapshots:
+            dev.discard_checkpoint()
+            snapshots.pop()
+        else:
+            dev.checkpoint()
+            snapshots.append(dict(state))
+        if rng.random() < 0.4:
+            assert _root_bytes(ex, dev.commit_resident(ex)) == \
+                _full_rebuild_root(state), f"fuzz step {step}"
+    assert _root_bytes(ex, dev.commit_resident(ex)) == \
+        _full_rebuild_root(state)
