@@ -1,0 +1,187 @@
+"""Branch-aware resident account mirror: drives one device-resident
+IncrementalTrie through a chain's verify/accept/reject lifecycle,
+including sibling competition and reorgs.
+
+The resident executor (ops/keccak_resident.py) holds a single linear
+trie history, but consensus verifies SIBLING blocks against different
+parents (core/blockchain.go:1424 reorg; plugin/evm/block.go Verify/
+Accept/Reject). This adapter reconciles the two:
+
+  - the mirror keeps a LINEAR applied stack (one undo scope per applied
+    block, native/mpt_inc.cpp checkpoint/rollback);
+  - verifying a block whose parent is not the current head REWINDS
+    (rollback scopes) to the nearest applied ancestor of the parent and
+    REPLAYS the saved per-block update batches down the target branch;
+  - accept finalizes: when every applied block is accepted, all undo
+    scopes flush (journal memory reclaimed);
+  - reject drops a block (and any applied descendants, which consensus
+    rejects with it) by rewinding through it.
+
+Each verify returns the block's state root from the device (lazy handle
+resolved to bytes), so the chain adapter can compare it against the
+header exactly where statedb.IntermediateRoot's result is used today
+(core/blockchain.go:1331 ValidateState).
+
+This is the round-5 chain-integration building block: what remains
+upstream is feeding it StateDB's per-block account updates and routing
+intermediate state reads through the mirror.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..native.mpt import IncrementalTrie
+
+
+class MirrorError(Exception):
+    pass
+
+
+class ResidentAccountMirror:
+    GENESIS = b"\x00" * 32  # sentinel parent of the initial state
+
+    def __init__(self, items: Sequence[Tuple[bytes, bytes]] = (),
+                 executor=None):
+        if executor is None:
+            from ..ops.keccak_resident import ResidentExecutor
+
+            executor = ResidentExecutor()
+        self.ex = executor
+        self.trie = IncrementalTrie(items)
+        # the genesis commit (everything is dirty after construction)
+        self._roots: Dict[bytes, bytes] = {
+            self.GENESIS: self.ex.root_bytes(
+                self.trie.commit_resident(self.ex))
+        }
+        self._parent: Dict[bytes, bytes] = {}
+        self._batch: Dict[bytes, List[Tuple[bytes, bytes]]] = {}
+        self._applied: List[bytes] = [self.GENESIS]
+        self._accepted: set = {self.GENESIS}
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def verify(self, parent_hash: bytes, block_hash: bytes,
+               updates: Sequence[Tuple[bytes, bytes]]) -> bytes:
+        """Apply [updates] on top of [parent_hash]'s state and return the
+        resulting state root. Saves the batch so later branch switches
+        can replay it."""
+        if parent_hash not in self._roots:
+            raise MirrorError(f"unknown parent {parent_hash.hex()[:8]}")
+        if block_hash in self._roots:
+            # re-verify of a known block: the root is cached, but the
+            # mirror must still LAND on that block's state (callers read
+            # intermediate state through the head)
+            if self._applied[-1] != block_hash:
+                self._switch_to(block_hash)
+            return self._roots[block_hash]
+        if self._applied[-1] != parent_hash:
+            self._switch_to(parent_hash)
+        self.trie.checkpoint()
+        self.trie.update(list(updates))
+        root = self.ex.root_bytes(self.trie.commit_resident(self.ex))
+        self._parent[block_hash] = parent_hash
+        self._batch[block_hash] = list(updates)
+        self._roots[block_hash] = root
+        self._applied.append(block_hash)
+        return root
+
+    def accept(self, block_hash: bytes) -> None:
+        """Finalize a block. When the whole applied stack is final, the
+        undo journal flushes (the common linear-chain steady state)."""
+        if block_hash not in self._roots:
+            raise MirrorError("accepting a block the mirror never saw")
+        self._accepted.add(block_hash)
+        self._maybe_flush()
+
+    def _maybe_flush(self) -> None:
+        if all(h in self._accepted for h in self._applied):
+            # every open scope is final: merge+clear the journal, and
+            # prune finalized records — a sibling branching below the
+            # finalized head can never apply again, so its parent lookup
+            # failing with "unknown parent" is the correct refusal
+            for _ in range(len(self._applied) - 1):
+                self.trie.discard_checkpoint()
+            head = self._applied[-1]
+            for h in self._applied[:-1]:
+                self._forget(h)
+            # the head is now the tree's root: drop its parent link so
+            # orphan pruning never mistakes it for unreachable
+            self._parent.pop(head, None)
+            self._applied = [head]
+            self._accepted = {head}
+
+    def reject(self, block_hash: bytes) -> None:
+        """Drop a block. If it is applied, rewind through it (consensus
+        rejects its applied descendants with it)."""
+        if block_hash in self._applied:
+            idx = self._applied.index(block_hash)
+            while len(self._applied) > idx:
+                dropped = self._applied.pop()
+                self.trie.rollback()
+                if dropped != block_hash:
+                    # descendant of the rejected block: gone with it
+                    self._forget(dropped)
+        self._forget(block_hash)
+        # unapplied descendants lost their replay path with the rejected
+        # block: prune orphans to a fixpoint (consensus rejects them too,
+        # but their Reject may never reach us once the parent is gone)
+        changed = True
+        while changed:
+            changed = False
+            for h, p in list(self._parent.items()):
+                if p not in self._roots:
+                    self._forget(h)
+                    changed = True
+        # dropping the last unaccepted block can make the stack final
+        self._maybe_flush()
+
+    @property
+    def head(self) -> bytes:
+        return self._applied[-1]
+
+    def root_of(self, block_hash: bytes) -> Optional[bytes]:
+        return self._roots.get(block_hash)
+
+    # ---- branch switching ------------------------------------------------
+
+    def _forget(self, block_hash: bytes) -> None:
+        self._roots.pop(block_hash, None)
+        self._parent.pop(block_hash, None)
+        self._batch.pop(block_hash, None)
+        self._accepted.discard(block_hash)
+
+    def _switch_to(self, target: bytes) -> None:
+        """Rewind to the nearest applied ancestor of [target], then
+        replay the saved batches down to it."""
+        # ancestry chain of target up to something applied
+        chain: List[bytes] = []
+        cur = target
+        applied_set = set(self._applied)
+        while cur not in applied_set:
+            chain.append(cur)
+            nxt = self._parent.get(cur)
+            if nxt is None:
+                raise MirrorError(
+                    f"no path from {target.hex()[:8]} to the mirror")
+            cur = nxt
+        # rewind to the common ancestor `cur` — check BEFORE popping so
+        # an error leaves the scope stack and _applied consistent
+        while self._applied[-1] != cur:
+            top = self._applied[-1]
+            if top in self._accepted:
+                raise MirrorError(
+                    "branch switch would rewind an ACCEPTED block "
+                    f"({top.hex()[:8]}) — finality violation")
+            self._applied.pop()
+            self.trie.rollback()
+        # replay down the target branch (deepest ancestor first)
+        for h in reversed(chain):
+            self.trie.checkpoint()
+            self.trie.update(self._batch[h])
+            root = self.ex.root_bytes(self.trie.commit_resident(self.ex))
+            if root != self._roots[h]:
+                self.trie.rollback()  # close the scope we just opened
+                raise MirrorError(
+                    f"replay of {h.hex()[:8]} produced a different root")
+            self._applied.append(h)
