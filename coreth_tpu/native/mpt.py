@@ -17,7 +17,7 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -354,6 +354,23 @@ def load_inc():
         lib.mpt_inc_rollback.argtypes = [ctypes.c_void_p]
         lib.mpt_inc_root.restype = None
         lib.mpt_inc_root.argtypes = [ctypes.c_void_p, _u8p]
+        lib.mpt_inc_get.restype = ctypes.c_int64
+        lib.mpt_inc_get.argtypes = [
+            ctypes.c_void_p, _u8p, _u8p, ctypes.c_int64,
+        ]
+        lib.mpt_inc_absorb_store.restype = None
+        lib.mpt_inc_absorb_store.argtypes = [
+            ctypes.c_void_p, _u8p, ctypes.c_int64,
+        ]
+        lib.mpt_inc_export_size.restype = ctypes.c_int64
+        lib.mpt_inc_export_size.argtypes = [
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ]
+        lib.mpt_inc_export_nodes.restype = None
+        lib.mpt_inc_export_nodes.argtypes = [
+            ctypes.c_void_p, _u8p, _u8p, _u64p,
+        ]
         lib.mpt_inc_free.restype = None
         lib.mpt_inc_free.argtypes = [ctypes.c_void_p]
         _inc_lib = lib
@@ -593,6 +610,47 @@ class IncrementalTrie:
         call right after commit planning to size the transfer."""
         return (int(self._lib.mpt_inc_num_dirty(self._h)),
                 int(self._lib.mpt_inc_flat_bytes(self._h)))
+
+    # ---- state reads + persistence export (the chain adapter's read
+    # seam and 4096-interval disk flush; reference trie/trie.go:87 Get,
+    # core/state_manager.go:153 interval Commit) ----
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        """Value lookup by 32-byte key; None when absent."""
+        if len(key) != 32:
+            raise ValueError("keys are 32 bytes (keccak-hashed)")
+        k = np.frombuffer(key, np.uint8)
+        out = np.empty(128, np.uint8)
+        n = int(self._lib.mpt_inc_get(self._h, k, out, out.shape[0]))
+        if n < 0:
+            return None
+        if n > out.shape[0]:
+            out = np.empty(n, np.uint8)
+            n = int(self._lib.mpt_inc_get(self._h, k, out, out.shape[0]))
+        return out[:n].tobytes()
+
+    def absorb_store(self, store) -> None:
+        """Pull device-store digests (executor.store read back to host as
+        uint32[S, 8]) into the native digest cache — the explicit sync
+        point before export_nodes() on a resident-committed trie."""
+        arr = np.ascontiguousarray(np.asarray(store)).view(np.uint8)
+        n_slots = arr.size // 32
+        self._lib.mpt_inc_absorb_store(self._h, arr.reshape(-1), n_slots)
+
+    def export_nodes(self):
+        """Export every hashed node as (digests uint8[N, 32], rlp bytes,
+        off uint64[N+1]) for the interval disk flush. The trie must be
+        clean (just committed); resident tries need absorb_store first."""
+        sz = np.empty(1, np.int64)
+        n = int(self._lib.mpt_inc_export_size(self._h, sz))
+        if n < 0:
+            raise RuntimeError("trie has uncommitted changes; commit first")
+        digests = np.empty((n, 32), np.uint8)
+        rlp_buf = np.empty(max(int(sz[0]), 1), np.uint8)
+        off = np.empty(n + 1, np.uint64)
+        self._lib.mpt_inc_export_nodes(self._h, digests.reshape(-1),
+                                       rlp_buf, off)
+        return digests, rlp_buf[:int(sz[0])].tobytes(), off
 
     def root(self) -> bytes:
         if self.num_nodes == 0:

@@ -903,6 +903,25 @@ void absorb_digests(Inc& t, const uint8_t* dig) {
   t.embedded_dirty.clear();
 }
 
+// post-order walk over every node; F(INode*)
+template <class F>
+void walk_all(INode* n, F&& f) {
+  if (!n) return;
+  if (n->kind == 2) {
+    for (auto* c : n->child) walk_all(c, f);
+  } else if (n->kind == 1) {
+    walk_all(n->child[0], f);
+  }
+  f(n);
+}
+
+// export policy: every hashed child reference is its literal cached digest
+struct LiteralPolicy {
+  void hashed_child(INode* c, int32_t, uint8_t* dst32) {
+    std::memcpy(dst32, c->digest, 32);
+  }
+};
+
 }  // namespace
 
 extern "C" {
@@ -1162,6 +1181,97 @@ void mpt_inc_root(void* h, uint8_t* out32) {
     std::memcpy(out32, t->root->digest, 32);
   else
     std::memset(out32, 0, 32);
+}
+
+// ---- state reads (mirror-backed chain reads) ------------------------------
+
+// Value lookup by 32-byte key. Returns the value length (copied into out
+// when it fits cap), or -1 when the key is absent. This is the read seam
+// the resident chain adapter serves StateDB misses from, replacing the
+// host trie walk of trie/trie.py get() (reference trie/trie.go:87).
+int64_t mpt_inc_get(void* h, const uint8_t* key32, uint8_t* out,
+                    int64_t cap) {
+  Inc* t = (Inc*)h;
+  INode* n = t->root;
+  int pos = 0;
+  while (n) {
+    if (n->kind == 2) {
+      if (pos >= 64) return -1;
+      n = n->child[nibble(key32, pos)];
+      ++pos;
+      continue;
+    }
+    if (pos + n->nnib > 64) return -1;
+    for (int i = 0; i < n->nnib; ++i)
+      if (n->frag[i] != nibble(key32, pos + i)) return -1;
+    pos += n->nnib;
+    if (n->kind == 0) {
+      if (pos != 64) return -1;
+      int64_t len = (int64_t)n->val.size();
+      if (out && cap >= len) std::memcpy(out, n->val.data(), len);
+      return len;
+    }
+    n = n->child[0];
+  }
+  return -1;
+}
+
+// ---- persistence sync point (interval commits) ----------------------------
+
+// Pull device-store digests back into the host node cache. store is the
+// executor's uint32[S, 8] read back as bytes (little-endian words — the
+// same layout root_bytes renders); nodes whose slot is out of range keep
+// their host digest. Resident commits defer absorption indefinitely; this
+// is the explicit sync point the 4096-interval persistence uses
+// (reference: trie/triedb/hashdb Commit, core/state_manager.go:153).
+void mpt_inc_absorb_store(void* h, const uint8_t* store, int64_t n_slots) {
+  Inc* t = (Inc*)h;
+  walk_all(t->root, [&](INode* n) {
+    if (n->slot >= 2 && n->slot < n_slots)
+      std::memcpy(n->digest, store + (int64_t)n->slot * 32, 32);
+  });
+}
+
+// Count of hashed (enc_len >= 32) nodes + their total RLP bytes, for
+// sizing mpt_inc_export_nodes buffers. Returns -1 if any node is dirty
+// (digests/enc_len not settled — commit first).
+int64_t mpt_inc_export_size(void* h, int64_t* total_rlp) {
+  Inc* t = (Inc*)h;
+  int64_t n_hashed = 0, bytes = 0;
+  bool dirty = false;
+  walk_all(t->root, [&](INode* n) {
+    if (n->dirty || n->enc_len < 0) dirty = true;
+    if (n->enc_len >= 32) {
+      ++n_hashed;
+      bytes += n->enc_len;
+    }
+  });
+  if (dirty) return -1;
+  *total_rlp = bytes;
+  return n_hashed;
+}
+
+// Export every hashed node as (digest32, rlp) for the interval disk
+// flush: digests -> uint8[n*32], rlp -> concatenated bytes with off[n+1]
+// prefix offsets (off[0] = 0). Embedded (<32B) nodes inline into their
+// parents exactly as the hashdb scheme stores them. Call
+// mpt_inc_absorb_store first when the trie is resident-committed.
+void mpt_inc_export_nodes(void* h, uint8_t* digests, uint8_t* rlp,
+                          uint64_t* off) {
+  Inc* t = (Inc*)h;
+  RowWriter<LiteralPolicy> w{{}, rlp};  // base only feeds the (unused)
+                                        // patch offset; must stay non-null
+  int64_t i = 0;
+  uint64_t pos = 0;
+  off[0] = 0;
+  walk_all(t->root, [&](INode* n) {
+    if (n->enc_len < 32) return;
+    std::memcpy(digests + i * 32, n->digest, 32);
+    uint8_t* out = rlp + pos;
+    w.write_node(n, out);
+    pos += (uint64_t)n->enc_len;
+    off[++i] = pos;
+  });
 }
 
 void mpt_inc_free(void* h) { delete (Inc*)h; }
