@@ -10,6 +10,10 @@ Configs (BASELINE.md "measurable baselines"):
   3  1k-tx block processing incl. batched sender recovery
   4  state-sync range-proof verification throughput
   5  batched keccak256 via the tpu_keccak stateful precompile (64KiB)
+  6-9  (see each bench_N docstring: sync e2e, bench.py legs, log filter,
+     resident commit)
+  10 chain-level insert with the RESIDENT account trie vs default —
+     the end-to-end number for the resident chain integration
 
 Each line: {"metric", "value", "unit", "vs_baseline", "config"} where
 vs_baseline compares the accelerated path against the host baseline of
@@ -76,9 +80,11 @@ def bench_2():
     _emit(2, "intermediate_root_1m_nodes_per_sec", dev, "nodes/s", dev / cpu)
 
 
-def bench_3():
-    """1k-tx block processing: build one 1k-tx block, then time
-    insert_block (ecrecover via the native batch + EVM + state commit)."""
+def _block_insert_rate(resident: bool = False):
+    """1k-tx block processing: build the blocks, then time insert_block
+    (ecrecover via the native batch + EVM + state commit). Returns
+    (n_txs, txs_per_sec). resident=True routes the account trie through
+    the device-resident mirror (CacheConfig.resident_account_trie)."""
     from coreth_tpu import params
     from coreth_tpu.consensus.dummy import new_dummy_engine
     from coreth_tpu.core.blockchain import BlockChain, CacheConfig
@@ -95,53 +101,61 @@ def bench_3():
     addrs = [priv_to_address(k) for k in keys]
     signer = Signer(43112)
 
-    def chain_and_block():
-        diskdb = MemoryDB()
-        genesis = Genesis(
-            config=params.TEST_CHAIN_CONFIG,
-            gas_limit=params.CORTINA_GAS_LIMIT,
-            alloc={a: GenesisAccount(balance=10**21) for a in addrs},
-        )
-        chain = BlockChain(
-            diskdb, CacheConfig(pruning=True), params.TEST_CHAIN_CONFIG,
-            genesis, new_dummy_engine(),
-            state_database=Database(TrieDatabase(diskdb)),
-        )
+    diskdb = MemoryDB()
+    genesis = Genesis(
+        config=params.TEST_CHAIN_CONFIG,
+        gas_limit=params.CORTINA_GAS_LIMIT,
+        alloc={a: GenesisAccount(balance=10**21) for a in addrs},
+    )
+    chain = BlockChain(
+        diskdb,
+        CacheConfig(pruning=True, resident_account_trie=resident),
+        params.TEST_CHAIN_CONFIG,
+        genesis, new_dummy_engine(),
+        state_database=Database(TrieDatabase(diskdb)),
+    )
 
-        # gas limits cap a block well under 1k transfers; the workload
-        # spans ceil(n/per_block) full blocks (core/bench_test.go ring1000
-        # shape), timed over all inserts
-        per_block = 500
-        n_blocks = (n_txs + per_block - 1) // per_block
+    # gas limits cap a block well under 1k transfers; the workload
+    # spans ceil(n/per_block) full blocks (core/bench_test.go ring1000
+    # shape), timed over all inserts
+    per_block = 500
+    n_blocks = (n_txs + per_block - 1) // per_block
 
-        def gen(i, bg):
-            bf = bg.base_fee() or params.APRICOT_PHASE3_INITIAL_BASE_FEE
-            for j in range(i * per_block, min((i + 1) * per_block, n_txs)):
-                tx = Transaction(
-                    type=2, chain_id=43112, nonce=0, max_fee=bf * 2,
-                    max_priority_fee=0, gas=21000,
-                    to=(0x8000 + j).to_bytes(20, "big"), value=1,
-                )
-                bg.add_tx(signer.sign(tx, keys[j]))
+    def gen(i, bg):
+        bf = bg.base_fee() or params.APRICOT_PHASE3_INITIAL_BASE_FEE
+        for j in range(i * per_block, min((i + 1) * per_block, n_txs)):
+            tx = Transaction(
+                type=2, chain_id=43112, nonce=0, max_fee=bf * 2,
+                max_priority_fee=0, gas=21000,
+                to=(0x8000 + j).to_bytes(20, "big"), value=1,
+            )
+            bg.add_tx(signer.sign(tx, keys[j]))
 
-        blocks, _ = generate_chain(
-            chain.config, chain.current_block, chain.engine,
-            chain.state_database, n_blocks, gen=gen,
-        )
-        for b in blocks:
-            for t in b.transactions:
-                t._sender = None  # generation cached senders; clear so
-                # insert_block pays the real batched-ecrecover cost
-        return chain, blocks
+    blocks, _ = generate_chain(
+        chain.config, chain.current_block, chain.engine,
+        chain.state_database, n_blocks, gen=gen,
+    )
+    for b in blocks:
+        for t in b.transactions:
+            t._sender = None  # generation cached senders; clear so
+            # insert_block pays the real batched-ecrecover cost
 
-    # signing via pure python is slow; do it once, reuse txs across runs
-    chain, blocks = chain_and_block()
     t0 = time.perf_counter()
     for b in blocks:
         chain.insert_block(b)
     dt = time.perf_counter() - t0
     chain.stop()
-    _emit(3, "block_insert_1k_txs_per_sec", n_txs / dt, "txs/s", 1.0)
+    return n_txs, n_txs / dt
+
+
+_DEFAULT_INSERT_RATE = None  # bench_3 result, reused by bench_10
+
+
+def bench_3():
+    global _DEFAULT_INSERT_RATE
+    n_txs, rate = _block_insert_rate()
+    _DEFAULT_INSERT_RATE = rate
+    _emit(3, "block_insert_1k_txs_per_sec", rate, "txs/s", 1.0)
 
 
 def bench_4():
@@ -445,6 +459,21 @@ def bench_9():
         print(json.dumps({"config": 9, **out}), flush=True)
 
 
+def bench_10():
+    """Chain-level resident-mode insert throughput vs the default path —
+    the end-to-end evidence for the resident chain integration (same
+    workload as config 3; vs_baseline = resident / default). Reuses
+    bench_3's default-leg measurement when it already ran this process
+    (a whole-suite run would otherwise pay the 1k pure-Python signings
+    a third time)."""
+    base_rate = _DEFAULT_INSERT_RATE
+    if base_rate is None:
+        _, base_rate = _block_insert_rate(resident=False)
+    n_txs, res_rate = _block_insert_rate(resident=True)
+    _emit(10, "resident_block_insert_txs_per_sec", res_rate, "txs/s",
+          res_rate / base_rate)
+
+
 def main():
     from coreth_tpu.utils import enable_compilation_cache
 
@@ -462,7 +491,7 @@ def main():
     watchdog = PhaseWatchdog(
         time.monotonic() + float(os.environ.get("CORETH_TPU_BENCH_WATCHDOG",
                                                 "1800")))
-    picks = [int(a) for a in sys.argv[1:]] or [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    picks = [int(a) for a in sys.argv[1:]] or [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]
     for i in picks:
         # configs 7/9 run bench.py legs under their own phase watchdogs
         # with larger budgets (900s cold warmup); the outer arm must not

@@ -19,7 +19,11 @@ from .. import rlp
 from ..state.database import Database
 from ..state.statedb import StateDB
 from . import rawdb
-from .state_manager import CappedMemoryTrieWriter, NoPruningTrieWriter
+from .state_manager import (
+    CappedMemoryTrieWriter,
+    NoPruningTrieWriter,
+    ResidentTrieWriter,
+)
 from .state_processor import StateProcessor
 from .types import Block, Body, Header, Receipt, create_bloom, derive_sha
 
@@ -41,6 +45,13 @@ class CacheConfig:
     # device keccak (trie/trie.go:618-619 parallel-threshold analog); "off":
     # recursive CPU hasher everywhere.
     device_hasher: str = "auto"
+    # device-resident account trie: per-block account hashing runs as one
+    # resident commit on the mirror (deferred absorb + template residency,
+    # ops/keccak_resident.py) instead of the Python trie walk; changed
+    # nodes flush to disk at commit_interval. Requires the native
+    # incremental planner AND pruning=True (interval persistence is a
+    # pruning policy); silently falls back when either is absent.
+    resident_account_trie: bool = False
     # bloom-bit index section (bloom_indexer.go BloomBitsBlocks)
     bloom_section_size: int = 4096
 
@@ -160,6 +171,36 @@ class BlockChain:
         # (loadLastState → reprocessState, blockchain.go:679,1745)
         if not self.has_state(self.last_accepted.root):
             self.reprocess_state(self.last_accepted, cache_config.commit_interval)
+
+        # resident account trie: boot the mirror from the last-accepted
+        # state (one ordered leaf scan of the disk image — recovery above
+        # guarantees it exists), then route account-trie lifecycle through
+        # it. Genesis/recovery writes above intentionally used the default
+        # writer; history before this point lives on disk.
+        self.mirror = None
+        # resident mode is a PRUNING policy (interval persistence): under
+        # pruning=False the archive guarantee — every block's state on
+        # disk — requires the default per-block commit path
+        if cache_config.resident_account_trie and cache_config.pruning:
+            from ..native.mpt import load_inc
+
+            if load_inc() is not None:
+                from ..trie.iterator import iterate_leaves
+                from ..trie.resident_mirror import ResidentAccountMirror
+
+                tr = self.state_database.triedb.open_trie(
+                    self.last_accepted.root)
+                self.mirror = ResidentAccountMirror(
+                    list(iterate_leaves(tr)),
+                    base_key=self.last_accepted.hash(),
+                )
+                self.state_database.mirror = self.mirror
+                self.trie_writer = ResidentTrieWriter(
+                    self.state_database.triedb,
+                    self.mirror,
+                    commit_interval=cache_config.commit_interval,
+                    memory_cap=cache_config.trie_dirty_limit,
+                )
 
         # flat snapshot tree over the last-accepted state (snapshot_limit
         # gates it, like CacheConfig.SnapshotLimit in the reference)
@@ -314,6 +355,9 @@ class BlockChain:
         from ..trie.node import EMPTY_ROOT
 
         if root == EMPTY_ROOT:
+            return True
+        mirror = getattr(self.state_database, "mirror", None)
+        if mirror is not None and mirror.has_root(root):
             return True
         return root in self.state_database.triedb or (
             self.diskdb.get(root) is not None

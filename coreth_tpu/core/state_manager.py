@@ -115,6 +115,91 @@ class CappedMemoryTrieWriter(TrieWriter):
             self.db.commit(last)
 
 
+class ResidentTrieWriter(TrieWriter):
+    """Trie policy for resident mode (CacheConfig.resident_account_trie):
+    the account trie's lifecycle rides the ResidentAccountMirror instead
+    of the dirty forest, while storage-trie nodes (still committed into
+    the TrieDatabase by StateDB.commit) flush on the same interval.
+
+    accept  -> mirror.accept (journal reclaim on linear finality) and, at
+               the commit interval, the delta export of changed account
+               nodes to disk (the hashdb-image flush the reference does in
+               state_manager.go:126-186 via triedb Commit) plus a full cap
+               of the storage-node forest.
+    reject  -> mirror.reject (rewind through the losing branch).
+    shutdown-> final export at the last accepted block so restart recovers
+               from <= commit_interval blocks back.
+    """
+
+    def __init__(self, triedb: TrieDatabase, mirror,
+                 commit_interval: int = COMMIT_INTERVAL,
+                 memory_cap: int = 256 * 1024 * 1024):
+        self.db = triedb
+        self.mirror = mirror
+        self.commit_interval = commit_interval
+        self.memory_cap = memory_cap
+        self._last_accepted = None
+
+    def insert_trie(self, block) -> None:
+        # account nodes never enter the forest; storage nodes ride the
+        # memory cap below. Nothing to pin: the mirror's applied stack is
+        # the reference's "root reference" for in-flight blocks.
+        if self.db.dirty_size > self.memory_cap:
+            self.db.cap(self.memory_cap * 3 // 4)
+
+    def accept_trie(self, block) -> None:
+        from ..trie.resident_mirror import MirrorError
+
+        try:
+            self.mirror.accept(block.hash())
+        except MirrorError as e:
+            # blocks the mirror never saw: boot-recovery replays through
+            # the default path (benign), or the mirror detached after a
+            # disk fallback (state/resident/fallbacks counter + warn in
+            # resident_trie.py) — count it so a stuck export shows up
+            from ..log import get_logger
+            from ..metrics import default_registry
+
+            default_registry.counter("state/resident/accept_misses").inc(1)
+            get_logger("state").warning(
+                "resident accept miss for block %d (%s) — interval export "
+                "skipped", block.number, e)
+            return
+        self._last_accepted = block
+        if self.commit_interval and block.number % self.commit_interval == 0:
+            self._export(block)
+
+    def reject_trie(self, block) -> None:
+        from ..trie.resident_mirror import MirrorError
+
+        try:
+            self.mirror.reject(block.hash())
+        except MirrorError:
+            pass
+
+    def _export(self, block) -> None:
+        from ..trie.resident_mirror import MirrorError
+
+        batch = self.db.diskdb.new_batch()
+        try:
+            self.mirror.export_to(batch.put, at_block=block.hash())
+        except MirrorError:
+            return  # block already beyond the rewind horizon; the next
+            #         boundary export covers its nodes
+        # children-first crash ordering: storage-trie nodes land BEFORE
+        # the account batch whose root node makes has_state() true — a
+        # crash between the writes must leave a root that either fully
+        # resolves or triggers reprocess_state, never a root with
+        # missing storage subtrees (same ordering triedb._commit_walk
+        # guarantees)
+        self.db.cap(0)
+        batch.write()
+
+    def shutdown(self) -> None:
+        if self._last_accepted is not None:
+            self._export(self._last_accepted)
+
+
 class _BoundedBuffer:
     """FIFO of size N; evicted items get the callback (state_manager.go:189+)."""
 

@@ -204,6 +204,24 @@ class EthBackend:
         if blk is None:
             raise RPCError(-32000, "block not found")
         state_trie = self.chain.state_database.open_trie(blk.root)
+        if getattr(state_trie, "resident", False):
+            # resident roots have no Python node objects to walk: flush
+            # the changed account nodes to disk (O(delta) export) and
+            # prove from the hashdb image like any historical root
+            from ..trie.resident_mirror import MirrorError
+
+            mirror = self.chain.state_database.mirror
+            try:
+                key = mirror.key_for_root(blk.root)
+                if key is None:  # pruned between open_trie and here
+                    raise MirrorError("root left the resident window")
+                batch = self.chain.diskdb.new_batch()
+                mirror.export_to(batch.put, at_block=key)
+                batch.write()
+            except MirrorError as e:
+                raise RPCError(-32000, f"state unavailable: {e}")
+            state_trie = self.chain.state_database.triedb.open_state_trie(
+                blk.root)
         account_proof = prove(state_trie.trie, keccak256(addr))
         blob = state_trie.get(addr)
         acct = Account.decode(blob) if blob else Account()

@@ -352,6 +352,8 @@ def load_inc():
         lib.mpt_inc_discard_checkpoint.argtypes = [ctypes.c_void_p]
         lib.mpt_inc_rollback.restype = ctypes.c_uint64
         lib.mpt_inc_rollback.argtypes = [ctypes.c_void_p]
+        lib.mpt_inc_flush_oldest.restype = None
+        lib.mpt_inc_flush_oldest.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.mpt_inc_root.restype = None
         lib.mpt_inc_root.argtypes = [ctypes.c_void_p, _u8p]
         lib.mpt_inc_get.restype = ctypes.c_int64
@@ -369,6 +371,15 @@ def load_inc():
         ]
         lib.mpt_inc_export_nodes.restype = None
         lib.mpt_inc_export_nodes.argtypes = [
+            ctypes.c_void_p, _u8p, _u8p, _u64p,
+        ]
+        lib.mpt_inc_export_delta_size.restype = ctypes.c_int64
+        lib.mpt_inc_export_delta_size.argtypes = [
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ]
+        lib.mpt_inc_export_delta_nodes.restype = None
+        lib.mpt_inc_export_delta_nodes.argtypes = [
             ctypes.c_void_p, _u8p, _u8p, _u64p,
         ]
         lib.mpt_inc_free.restype = None
@@ -605,6 +616,13 @@ class IncrementalTrie:
         left dirty, so the next commit re-plans them."""
         return int(self._lib.mpt_inc_rollback(self._h))
 
+    def flush_oldest_checkpoints(self, k: int) -> None:
+        """Drop the OLDEST [k] scopes, keeping their changes and freeing
+        their journal memory — the tip-buffer flush (finalized history
+        deeper than the retained window stops being rewindable)."""
+        if k > 0:
+            self._lib.mpt_inc_flush_oldest(self._h, k)
+
     def dirty_stats(self):
         """(dirty hashed nodes, mini-plan bytes) of the CURRENT plan —
         call right after commit planning to size the transfer."""
@@ -637,19 +655,28 @@ class IncrementalTrie:
         n_slots = arr.size // 32
         self._lib.mpt_inc_absorb_store(self._h, arr.reshape(-1), n_slots)
 
-    def export_nodes(self):
-        """Export every hashed node as (digests uint8[N, 32], rlp bytes,
+    def export_nodes(self, delta: bool = False):
+        """Export hashed nodes as (digests uint8[N, 32], rlp bytes,
         off uint64[N+1]) for the interval disk flush. The trie must be
-        clean (just committed); resident tries need absorb_store first."""
+        clean (just committed); resident tries need absorb_store first.
+
+        delta=True exports only nodes re-hashed since the previous export
+        (full or delta) — an O(changed) overlay that, together with what
+        is already on disk, forms a complete hashdb image of the current
+        root (reference trie/triedb/hashdb Commit walks its dirty forest
+        the same way)."""
         sz = np.empty(1, np.int64)
-        n = int(self._lib.mpt_inc_export_size(self._h, sz))
+        size_fn = (self._lib.mpt_inc_export_delta_size if delta
+                   else self._lib.mpt_inc_export_size)
+        n = int(size_fn(self._h, sz))
         if n < 0:
             raise RuntimeError("trie has uncommitted changes; commit first")
         digests = np.empty((n, 32), np.uint8)
         rlp_buf = np.empty(max(int(sz[0]), 1), np.uint8)
         off = np.empty(n + 1, np.uint64)
-        self._lib.mpt_inc_export_nodes(self._h, digests.reshape(-1),
-                                       rlp_buf, off)
+        export_fn = (self._lib.mpt_inc_export_delta_nodes if delta
+                     else self._lib.mpt_inc_export_nodes)
+        export_fn(self._h, digests.reshape(-1), rlp_buf, off)
         return digests, rlp_buf[:int(sz[0])].tobytes(), off
 
     def root(self) -> bytes:

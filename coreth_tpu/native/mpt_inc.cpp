@@ -68,6 +68,12 @@ inline void write_compact_frag(const uint8_t* nib, int nnib, bool term,
 struct INode {
   uint8_t kind;     // 0 leaf, 1 ext, 2 branch
   bool dirty;
+  // changed (re-hashed) since the last disk export: drives the O(delta)
+  // interval flush (mpt_inc_export_delta_*) the resident chain adapter
+  // uses in place of a full-image export — the analog of the reference's
+  // dirty-forest Commit walking only nodes not yet on disk
+  // (trie/triedb/hashdb database.go Commit)
+  bool unexported;
   // resident mode: this node's device ROW bytes changed (not just a child
   // digest) — set by the updater on any mutation of the node's own
   // template (fragment/value/child-set/kind), by plan-time checks on
@@ -86,7 +92,8 @@ struct INode {
   INode* child[16];          // branch children; ext: child[0]
 
   INode(uint8_t k)
-      : kind(k), dirty(true), structural(true), nnib(0), row_blocks(0),
+      : kind(k), dirty(true), unexported(true), structural(true), nnib(0),
+        row_blocks(0),
         enc_len(-1), prev_enc(-1), lane(-1), slot(-1), row(-1) {
     std::memset(child, 0, sizeof(child));
   }
@@ -881,11 +888,13 @@ void res_mark_clean(Inc& t) {
   for (auto& seg : t.rsegs)
     for (INode* n : seg.node_of_lane) {
       n->dirty = false;
+      n->unexported = true;
       n->structural = false;
       n->lane = -1;
     }
   for (INode* n : t.r_embedded_dirty) {
     n->dirty = false;
+    n->unexported = true;
     n->structural = false;
   }
   t.r_embedded_dirty.clear();
@@ -897,9 +906,13 @@ void absorb_digests(Inc& t, const uint8_t* dig) {
       INode* n = seg.node_of_lane[lane];
       std::memcpy(n->digest, dig + ((int64_t)seg.gstart + lane) * 32, 32);
       n->dirty = false;
+      n->unexported = true;
       n->lane = -1;
     }
-  for (INode* n : t.embedded_dirty) n->dirty = false;
+  for (INode* n : t.embedded_dirty) {
+    n->dirty = false;
+    n->unexported = true;
+  }
   t.embedded_dirty.clear();
 }
 
@@ -976,6 +989,26 @@ void mpt_inc_discard_checkpoint(void* h) {
   t->undo_marks.pop_back();
   // with an enclosing scope, entries stay — they belong to it now
   if (t->undo_marks.empty()) t->undo_log.clear();
+}
+
+// Drop the OLDEST k checkpoints, keeping their changes and reclaiming
+// their journal entries. The remaining scopes rebase onto the new log
+// floor. This is the tip-buffer flush: finalized history deeper than
+// the retained window stops being rewindable, so its undo memory frees
+// (reference: the 32-root tip buffer of core/state_manager.go:189+
+// bounds how far back recent-state reads reach).
+void mpt_inc_flush_oldest(void* h, uint64_t k) {
+  Inc* t = (Inc*)h;
+  if (k == 0 || t->undo_marks.empty()) return;
+  if (k >= t->undo_marks.size()) {
+    t->undo_marks.clear();
+    t->undo_log.clear();
+    return;
+  }
+  size_t floor = t->undo_marks[k];
+  t->undo_log.erase(t->undo_log.begin(), t->undo_log.begin() + floor);
+  t->undo_marks.erase(t->undo_marks.begin(), t->undo_marks.begin() + k);
+  for (size_t& m : t->undo_marks) m -= floor;
 }
 
 // Revert every update since the most recent checkpoint (reverse replay
@@ -1265,6 +1298,49 @@ void mpt_inc_export_nodes(void* h, uint8_t* digests, uint8_t* rlp,
   uint64_t pos = 0;
   off[0] = 0;
   walk_all(t->root, [&](INode* n) {
+    n->unexported = false;  // a full image supersedes any pending delta
+    if (n->enc_len < 32) return;
+    std::memcpy(digests + i * 32, n->digest, 32);
+    uint8_t* out = rlp + pos;
+    w.write_node(n, out);
+    pos += (uint64_t)n->enc_len;
+    off[++i] = pos;
+  });
+}
+
+// Delta variants: only nodes re-hashed since the last export (full or
+// delta). Together with the previously exported image they form a
+// complete hashdb overlay for the current root — unchanged subtrees keep
+// their unchanged digests, so on-disk references stay valid. Same
+// contract as the full export: digests must be settled (commit first;
+// absorb_store first when resident-committed). Returns -1 while dirty.
+int64_t mpt_inc_export_delta_size(void* h, int64_t* total_rlp) {
+  Inc* t = (Inc*)h;
+  int64_t n_hashed = 0, bytes = 0;
+  bool dirty = false;
+  walk_all(t->root, [&](INode* n) {
+    if (n->dirty || n->enc_len < 0) dirty = true;
+    if (n->unexported && n->enc_len >= 32) {
+      ++n_hashed;
+      bytes += n->enc_len;
+    }
+  });
+  if (dirty) return -1;
+  *total_rlp = bytes;
+  return n_hashed;
+}
+
+void mpt_inc_export_delta_nodes(void* h, uint8_t* digests, uint8_t* rlp,
+                                uint64_t* off) {
+  Inc* t = (Inc*)h;
+  RowWriter<LiteralPolicy> w{{}, rlp};
+  int64_t i = 0;
+  uint64_t pos = 0;
+  off[0] = 0;
+  walk_all(t->root, [&](INode* n) {
+    if (!n->unexported) return;
+    n->unexported = false;  // embedded nodes clear too: they ride inline
+                            // in the parent row being exported this pass
     if (n->enc_len < 32) return;
     std::memcpy(digests + i * 32, n->digest, 32);
     uint8_t* out = rlp + pos;

@@ -21,10 +21,19 @@ class Database:
     def __init__(self, triedb: TrieDatabase):
         self.triedb = triedb
         self.diskdb = triedb.diskdb
+        # resident mode (CacheConfig.resident_account_trie): the chain
+        # installs its ResidentAccountMirror here; roots the mirror holds
+        # open as device-resident facades, everything else (historical /
+        # exported states) opens as the regular disk-backed trie
+        self.mirror = None
         self._code_cache: Dict[bytes, bytes] = {}
         self._code_cache_size = 0
 
-    def open_trie(self, root: bytes = EMPTY_ROOT) -> StateTrie:
+    def open_trie(self, root: bytes = EMPTY_ROOT):
+        if self.mirror is not None and self.mirror.has_root(root):
+            from .resident_trie import MirrorStateTrie
+
+            return MirrorStateTrie(self.mirror, root, self.triedb)
         return self.triedb.open_state_trie(root)
 
     def open_storage_trie(self, addr_hash: bytes, root: bytes) -> StateTrie:

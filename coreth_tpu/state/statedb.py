@@ -97,6 +97,10 @@ class StateDB:
         from .trie_prefetcher import TriePrefetcher
 
         self.stop_prefetcher()
+        if getattr(self.trie, "resident", False):
+            # resident account reads are O(path) native lookups with no
+            # triedb cache to warm; a prefetcher would only add threads
+            return
         self.prefetcher = TriePrefetcher(self.db, namespace)
 
     def stop_prefetcher(self) -> None:
@@ -385,7 +389,12 @@ class StateDB:
 
         self.finalise(delete_empty)
         marker = getattr(self.db.triedb, "batch_keccak", None)
-        if getattr(marker, "planned", False):
+        # resident mode: the facade buffers account writes and previews
+        # the root through the mirror — the plain loop below IS the
+        # resident path; the planned graph builder (which walks Python
+        # account-trie nodes this StateDB doesn't have) must not engage
+        if not getattr(self.trie, "resident", False) and getattr(
+                marker, "planned", False):
             est = len(self._objects_pending) + sum(
                 len(self._objects[a].pending_storage)
                 for a in self._objects_pending
@@ -542,8 +551,17 @@ class StateDB:
                         stor[hk] = rlp.encode(v.lstrip(b"\x00")) if v != ZERO32 else b""
                 obj.snap_flush = {}
         with expensive_timer("state/account/commits"):
-            root, acct_set = self.trie.commit(collect_leaf=True)
-        merged.merge(acct_set)
+            if getattr(self.trie, "resident", False):
+                # device-resident account trie: the mirror records the
+                # block's state (nodes persist via the interval export,
+                # not the Python dirty forest); nodeset only materialises
+                # on the disk-fallback path
+                root, acct_set = self.trie.commit_block(
+                    block_hash, parent_block_hash)
+            else:
+                root, acct_set = self.trie.commit(collect_leaf=True)
+        if acct_set is not None:
+            merged.merge(acct_set)
         self._objects_dirty = set()
         if root != self.original_root and merged.sets:
             self.db.triedb.update(root, self.original_root, merged)

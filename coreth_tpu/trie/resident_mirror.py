@@ -12,8 +12,10 @@ Accept/Reject). This adapter reconciles the two:
   - verifying a block whose parent is not the current head REWINDS
     (rollback scopes) to the nearest applied ancestor of the parent and
     REPLAYS the saved per-block update batches down the target branch;
-  - accept finalizes: when every applied block is accepted, all undo
-    scopes flush (journal memory reclaimed);
+  - accept finalizes: scopes (and records) of accepted blocks deeper
+    than the TIP_BUFFER flush (journal memory reclaimed); the retained
+    window keeps recent accepted states rewindable for reads — the
+    reference's 32-root tip buffer (core/state_manager.go:189+);
   - reject drops a block (and any applied descendants, which consensus
     rejects with it) by rewinding through it.
 
@@ -22,13 +24,17 @@ resolved to bytes), so the chain adapter can compare it against the
 header exactly where statedb.IntermediateRoot's result is used today
 (core/blockchain.go:1331 ValidateState).
 
-This is the round-5 chain-integration building block: what remains
-upstream is feeding it StateDB's per-block account updates and routing
-intermediate state reads through the mirror.
+Upstream integration: state/resident_trie.py (the StateDB facade that
+feeds per-block account batches and reads through here),
+core/state_manager.py ResidentTrieWriter (consensus lifecycle + the
+interval disk export), core/blockchain.py CacheConfig.resident_account_
+trie (boot + wiring).
 """
 
 from __future__ import annotations
 
+import functools
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..native.mpt import IncrementalTrie
@@ -38,34 +44,60 @@ class MirrorError(Exception):
     pass
 
 
+def _locked(fn):
+    """Serialize public mirror ops: the chain calls verify/preview from
+    the insert path (under chainmu) but accept/export ride the async
+    acceptor thread (core/blockchain.py _accept_post_process)."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *a, **kw):
+        with self._lock:
+            return fn(self, *a, **kw)
+
+    return wrapper
+
+
 class ResidentAccountMirror:
     GENESIS = b"\x00" * 32  # sentinel parent of the initial state
+    # single in-flight anonymous state (a miner's block-under-construction:
+    # root computed before the block hash exists; the next verify with the
+    # same parent+batch adopts it, anything else rewinds it)
+    ANON = b"\x01" + b"anon" * 7 + b"\x01\x01\x01"
 
     def __init__(self, items: Sequence[Tuple[bytes, bytes]] = (),
-                 executor=None):
+                 executor=None, base_key: Optional[bytes] = None):
         if executor is None:
             from ..ops.keccak_resident import ResidentExecutor
 
             executor = ResidentExecutor()
         self.ex = executor
+        self._lock = threading.RLock()
         self.trie = IncrementalTrie(items)
+        base = base_key if base_key is not None else self.GENESIS
         # the genesis commit (everything is dirty after construction)
         self._roots: Dict[bytes, bytes] = {
-            self.GENESIS: self.ex.root_bytes(
-                self.trie.commit_resident(self.ex))
+            base: self.ex.root_bytes(self.trie.commit_resident(self.ex))
+        }
+        self._by_root: Dict[bytes, List[bytes]] = {
+            self._roots[base]: [base]
         }
         self._parent: Dict[bytes, bytes] = {}
         self._batch: Dict[bytes, List[Tuple[bytes, bytes]]] = {}
-        self._applied: List[bytes] = [self.GENESIS]
-        self._accepted: set = {self.GENESIS}
+        self._batch_keys: Dict[bytes, frozenset] = {}  # lazy overlay index
+        self._applied: List[bytes] = [base]
+        self._accepted: set = {base}
+        self._dirty_since_export = True  # genesis image not yet on disk
 
     # ---- lifecycle -------------------------------------------------------
 
+    @_locked
     def verify(self, parent_hash: bytes, block_hash: bytes,
                updates: Sequence[Tuple[bytes, bytes]]) -> bytes:
         """Apply [updates] on top of [parent_hash]'s state and return the
         resulting state root. Saves the batch so later branch switches
         can replay it."""
+        if parent_hash == self.ANON:
+            parent_hash = self._promote_anon()
         if parent_hash not in self._roots:
             raise MirrorError(f"unknown parent {parent_hash.hex()[:8]}")
         if block_hash in self._roots:
@@ -75,57 +107,169 @@ class ResidentAccountMirror:
             if self._applied[-1] != block_hash:
                 self._switch_to(block_hash)
             return self._roots[block_hash]
+        updates = list(updates)
+        # a matching anonymous preview (the miner's block-under-
+        # construction) is this block's state already applied: adopt it
+        if (self.ANON in self._roots
+                and self._parent.get(self.ANON) == parent_hash
+                and self._batch.get(self.ANON) == updates
+                and self._applied and self._applied[-1] == self.ANON):
+            root = self._roots[self.ANON]
+            self._rename_anon(block_hash)
+            return root
+        self._drop_anon()
         if self._applied[-1] != parent_hash:
             self._switch_to(parent_hash)
         self.trie.checkpoint()
-        self.trie.update(list(updates))
+        self.trie.update(updates)
         root = self.ex.root_bytes(self.trie.commit_resident(self.ex))
-        self._parent[block_hash] = parent_hash
-        self._batch[block_hash] = list(updates)
-        self._roots[block_hash] = root
-        self._applied.append(block_hash)
+        self._dirty_since_export = True
+        self._record(block_hash, parent_hash, updates, root)
         return root
 
+    @_locked
+    def preview(self, parent_hash: bytes,
+                updates: Sequence[Tuple[bytes, bytes]]) -> bytes:
+        """Compute the root [updates] would produce on top of
+        [parent_hash] WITHOUT naming a block — the miner's path, where
+        the block hash depends on this root. The state stays applied as
+        the single anonymous head; the next verify with the same
+        parent+batch adopts it for free, anything else rewinds it."""
+        if parent_hash == self.ANON:
+            parent_hash = self._promote_anon()
+        if parent_hash not in self._roots:
+            raise MirrorError(f"unknown parent {parent_hash.hex()[:8]}")
+        updates = list(updates)
+        if (self.ANON in self._roots
+                and self._parent.get(self.ANON) == parent_hash
+                and self._batch.get(self.ANON) == updates):
+            if self._applied and self._applied[-1] != self.ANON:
+                self._switch_to(self.ANON)
+            return self._roots[self.ANON]
+        self._drop_anon()
+        if self._applied[-1] != parent_hash:
+            self._switch_to(parent_hash)
+        self.trie.checkpoint()
+        self.trie.update(updates)
+        root = self.ex.root_bytes(self.trie.commit_resident(self.ex))
+        self._dirty_since_export = True
+        self._record(self.ANON, parent_hash, updates, root)
+        return root
+
+    # side-branch records (phantom previews, losing forks) kept replayable
+    # before GC reclaims the oldest — generous: consensus only builds on
+    # recent blocks (the reference's dirty forest is similarly bounded)
+    MAX_SIDE_RECORDS = 512
+
+    def _record(self, key: bytes, parent: bytes,
+                batch: List[Tuple[bytes, bytes]], root: bytes) -> None:
+        self._parent[key] = parent
+        self._batch[key] = batch
+        self._roots[key] = root
+        self._by_root.setdefault(root, []).append(key)
+        self._applied.append(key)
+        extra = len(self._roots) - len(self._applied)
+        if extra > self.MAX_SIDE_RECORDS:
+            applied = set(self._applied)
+            for k in list(self._roots):
+                if extra <= self.MAX_SIDE_RECORDS:
+                    break
+                if k in applied or k in self._accepted:
+                    continue
+                self._forget(k)
+                extra -= 1
+
+    def _promote_anon(self) -> bytes:
+        """Name the anonymous head by its ROOT so new work can build on
+        top of it — chain generation commits block k+1's state before
+        block k has a hash. When the real block arrives, verify() records
+        it under its hash; the promoted record ages out via the
+        side-record GC."""
+        if self.ANON not in self._roots:
+            raise MirrorError("no anonymous state to build on")
+        root = self._roots[self.ANON]
+        if root in self._roots:
+            # an identically-rooted record already exists (e.g. an empty
+            # batch on a promoted parent): collapse onto it
+            self._drop_anon()
+            return root
+        self._rename_anon(root)
+        return root
+
+    def _rename_anon(self, block_hash: bytes) -> None:
+        root = self._roots[self.ANON]
+        parent = self._parent[self.ANON]
+        batch = self._batch[self.ANON]
+        # the anon may have been rewound off the stack by an intervening
+        # read/switch — its record is still renameable
+        idx = (self._applied.index(self.ANON)
+               if self.ANON in self._applied else None)
+        self._forget(self.ANON)
+        if idx is not None:
+            self._applied[idx] = block_hash
+        self._parent[block_hash] = parent
+        self._batch[block_hash] = batch
+        self._roots[block_hash] = root
+        self._by_root.setdefault(root, []).append(block_hash)
+
+    def _drop_anon(self) -> None:
+        if self.ANON not in self._roots:
+            return
+        if self.ANON in self._applied:
+            idx = self._applied.index(self.ANON)
+            while len(self._applied) > idx:
+                dropped = self._applied.pop()
+                self.trie.rollback()
+                self._dirty_since_export = True
+                if dropped != self.ANON:
+                    self._forget(dropped)
+        self._forget(self.ANON)
+
+    @_locked
     def accept(self, block_hash: bytes) -> None:
-        """Finalize a block. When the whole applied stack is final, the
-        undo journal flushes (the common linear-chain steady state)."""
+        """Finalize a block. Scopes of finalized history deeper than the
+        tip buffer flush (the common linear-chain steady state keeps a
+        rolling TIP_BUFFER-deep readable window)."""
         if block_hash not in self._roots:
             raise MirrorError("accepting a block the mirror never saw")
         self._accepted.add(block_hash)
         self._maybe_flush()
 
-    def _maybe_flush(self) -> None:
-        if all(h in self._accepted for h in self._applied):
-            # every open scope is final: merge+clear the journal, and
-            # prune finalized records — a sibling branching below the
-            # finalized head can never apply again, so its parent lookup
-            # failing with "unknown parent" is the correct refusal
-            for _ in range(len(self._applied) - 1):
-                self.trie.discard_checkpoint()
-            head = self._applied[-1]
-            for h in self._applied[:-1]:
-                self._forget(h)
-            # the head is now the tree's root: drop its parent link so
-            # orphan pruning never mistakes it for unreachable
-            self._parent.pop(head, None)
-            self._applied = [head]
-            self._accepted = {head}
+    # finalized blocks whose undo scopes (and records) stay retained so
+    # recent-state reads keep working — the reference's 32-root tip
+    # buffer (core/state_manager.go:189+ / TIP_BUFFER_SIZE)
+    TIP_BUFFER = 32
 
-    def reject(self, block_hash: bytes) -> None:
-        """Drop a block. If it is applied, rewind through it (consensus
-        rejects its applied descendants with it)."""
-        if block_hash in self._applied:
-            idx = self._applied.index(block_hash)
-            while len(self._applied) > idx:
-                dropped = self._applied.pop()
-                self.trie.rollback()
-                if dropped != block_hash:
-                    # descendant of the rejected block: gone with it
-                    self._forget(dropped)
-        self._forget(block_hash)
-        # unapplied descendants lost their replay path with the rejected
-        # block: prune orphans to a fixpoint (consensus rejects them too,
-        # but their Reject may never reach us once the parent is gone)
+    def _maybe_flush(self) -> None:
+        # the finalized PREFIX of the stack (base + contiguous accepted
+        # blocks; anything above can still be rejected and must stay
+        # rewindable). Scopes deeper than the tip buffer flush; history
+        # below the new base stops being rewindable, so a sibling
+        # branching there can never apply again and its parent lookup
+        # failing is the correct refusal
+        m = 0
+        while (m + 1 < len(self._applied)
+               and self._applied[m + 1] in self._accepted):
+            m += 1
+        n_flush = m - self.TIP_BUFFER
+        if n_flush <= 0:
+            return
+        self.trie.flush_oldest_checkpoints(n_flush)
+        evicted, self._applied = (
+            self._applied[:n_flush], self._applied[n_flush:])
+        for h in evicted:
+            self._forget(h)
+            self._accepted.discard(h)
+        # the new base is the tree's floor: drop its parent link so
+        # orphan pruning never mistakes it for unreachable
+        self._parent.pop(self._applied[0], None)
+        # side records that branched below the new base (stale promoted
+        # previews, losing siblings) lost their replay path
+        self._prune_orphans()
+
+    def _prune_orphans(self) -> None:
+        """Forget every record whose parent record is gone (no replay
+        path can reach it anymore), to a fixpoint."""
         changed = True
         while changed:
             changed = False
@@ -133,22 +277,187 @@ class ResidentAccountMirror:
                 if p not in self._roots:
                     self._forget(h)
                     changed = True
+
+    @_locked
+    def reject(self, block_hash: bytes) -> None:
+        """Drop a block. If it is applied, rewind through it (consensus
+        rejects its applied descendants with it)."""
+        if block_hash in self._accepted:
+            # with the tip buffer, accepted blocks stay on the stack for
+            # TIP_BUFFER blocks — a duplicate/out-of-order Reject must
+            # not rewind finalized state through them
+            raise MirrorError(
+                f"rejecting an ACCEPTED block ({block_hash.hex()[:8]})")
+        if block_hash in self._applied:
+            idx = self._applied.index(block_hash)
+            while len(self._applied) > idx:
+                dropped = self._applied.pop()
+                self.trie.rollback()
+                self._dirty_since_export = True
+                if dropped != block_hash:
+                    # descendant of the rejected block: gone with it
+                    self._forget(dropped)
+        self._forget(block_hash)
+        # unapplied descendants lost their replay path with the rejected
+        # block (consensus rejects them too, but their Reject may never
+        # reach us once the parent is gone)
+        self._prune_orphans()
         # dropping the last unaccepted block can make the stack final
         self._maybe_flush()
 
     @property
     def head(self) -> bytes:
-        return self._applied[-1]
+        with self._lock:
+            return self._applied[-1]
 
+    @_locked
     def root_of(self, block_hash: bytes) -> Optional[bytes]:
         return self._roots.get(block_hash)
+
+    @_locked
+    def has_root(self, root: bytes) -> bool:
+        return root in self._by_root
+
+    @_locked
+    def key_for_root(self, root: bytes) -> Optional[bytes]:
+        """A block key whose state has [root]. Prefers a key on the
+        applied stack (always reachable); identical-root records off the
+        stack (stale promoted previews) may sit beyond the rewind
+        horizon."""
+        keys = self._by_root.get(root)
+        if not keys:
+            return None
+        applied = set(self._applied)
+        for k in reversed(keys):
+            if k in applied:
+                return k
+        return keys[-1]
+
+    # ---- reads (chain adapter state reads at a resident root) ------------
+
+    @_locked
+    def read(self, root: bytes, key32: bytes) -> Optional[bytes]:
+        """Value of [key32] in the state identified by [root]. Positions
+        the trie at a block with that root (identical-root blocks have
+        identical state, so any is correct). Raises MirrorError when the
+        root is not resident or no longer reachable (accepted history —
+        serve those from the exported disk image instead)."""
+        keys = self._by_root.get(root)
+        if not keys:
+            raise MirrorError("root not resident")
+        if self._roots.get(self._applied[-1]) == root:
+            return self.trie.get(key32)
+        # overlay shortcut: if [key32] is untouched by every batch on
+        # both legs of the path between a target block and the head, the
+        # head's value IS the target's value — serve it without
+        # repositioning (an RPC StateDB at block N-1 interleaved with
+        # processing at N would otherwise pay two branch switches, each
+        # a device commit, per account read)
+        for k in keys:
+            if self._untouched_between(k, key32):
+                return self.trie.get(key32)
+        last_err: Optional[MirrorError] = None
+        for k in list(keys):
+            try:
+                self._switch_to(k)
+                return self.trie.get(key32)
+            except MirrorError as e:
+                last_err = e
+        raise last_err if last_err is not None else MirrorError(
+            "root unreachable")
+
+    def _batch_keys_of(self, k: bytes):
+        s = self._batch_keys.get(k)
+        if s is None:
+            b = self._batch.get(k)
+            if b is None:
+                return None
+            s = self._batch_keys[k] = frozenset(kk for kk, _ in b)
+        return s
+
+    def _untouched_between(self, target: bytes, key32: bytes) -> bool:
+        """True iff no batch on target->ancestor or ancestor->head
+        touches [key32], where ancestor is target's nearest applied
+        ancestor — then the value at the head equals the value at
+        target's state."""
+        applied_idx = {k: i for i, k in enumerate(self._applied)}
+        chain: List[bytes] = []
+        cur = target
+        while cur not in applied_idx:
+            p = self._parent.get(cur)
+            if p is None:
+                return False
+            chain.append(cur)
+            cur = p
+        for k in chain:
+            s = self._batch_keys_of(k)
+            if s is None or key32 in s:
+                return False
+        for k in self._applied[applied_idx[cur] + 1:]:
+            s = self._batch_keys_of(k)
+            if s is None or key32 in s:
+                return False
+        return True
+
+    # ---- interval persistence (disk flush of changed nodes) --------------
+
+    @_locked
+    def export_to(self, put, at_block: Optional[bytes] = None) -> int:
+        """Write every account-trie node changed since the previous
+        export to [put(digest32, rlp_blob)] — the commit-interval disk
+        flush (reference trie/triedb/hashdb Commit via
+        core/state_manager.go:153). Positions the trie at [at_block]
+        (typically the just-accepted block) first so the on-disk image is
+        complete for that block's root. Returns nodes written.
+
+        Content-addressed writes make sibling/abandoned-branch nodes
+        harmless on disk: they are unreachable garbage the offline
+        pruner sweeps, exactly like the reference's stale hashdb nodes."""
+        import numpy as np
+
+        if not self._dirty_since_export and (
+            at_block is None or self._applied[-1] == at_block
+        ):
+            # nothing re-hashed since the last export at this position:
+            # skip the store readback + full-trie walk (an RPC client
+            # polling eth_getProof per block would otherwise make every
+            # call O(total nodes))
+            return 0
+        if at_block is not None and self._applied[-1] != at_block:
+            self._switch_to(at_block)
+        if self.trie.num_nodes == 0:
+            return 0
+        # a rewind-only switch leaves the reverted paths dirty (rollback
+        # replays through the updater, native/mpt.py rollback): re-commit
+        # so digests are settled before the export reads them. A clean
+        # trie plans nothing, so this is free in the common case.
+        self.trie.commit_resident(self.ex)
+        self.trie.absorb_store(np.asarray(self.ex.store))
+        try:
+            digs, blob, off = self.trie.export_nodes(delta=True)
+        except RuntimeError as e:  # dirty-trie guard: surface as ours
+            raise MirrorError(f"export on unsettled trie: {e}")
+        for i in range(digs.shape[0]):
+            put(digs[i].tobytes(), blob[int(off[i]):int(off[i + 1])])
+        self._dirty_since_export = False
+        return int(digs.shape[0])
 
     # ---- branch switching ------------------------------------------------
 
     def _forget(self, block_hash: bytes) -> None:
-        self._roots.pop(block_hash, None)
+        root = self._roots.pop(block_hash, None)
+        if root is not None:
+            keys = self._by_root.get(root)
+            if keys is not None:
+                try:
+                    keys.remove(block_hash)
+                except ValueError:
+                    pass
+                if not keys:
+                    del self._by_root[root]
         self._parent.pop(block_hash, None)
         self._batch.pop(block_hash, None)
+        self._batch_keys.pop(block_hash, None)
         self._accepted.discard(block_hash)
 
     def _switch_to(self, target: bytes) -> None:
@@ -165,20 +474,21 @@ class ResidentAccountMirror:
                 raise MirrorError(
                     f"no path from {target.hex()[:8]} to the mirror")
             cur = nxt
-        # rewind to the common ancestor `cur` — check BEFORE popping so
-        # an error leaves the scope stack and _applied consistent
+        # rewind to the common ancestor `cur`. Accepted blocks within the
+        # tip buffer rewind like any other (recent-state reads position
+        # here); their records are retained, so the canonical path
+        # replays back on the next forward switch. True finality is the
+        # flushed base: anything below it has no record and the ancestry
+        # walk above already refused it.
         while self._applied[-1] != cur:
-            top = self._applied[-1]
-            if top in self._accepted:
-                raise MirrorError(
-                    "branch switch would rewind an ACCEPTED block "
-                    f"({top.hex()[:8]}) — finality violation")
             self._applied.pop()
             self.trie.rollback()
+            self._dirty_since_export = True
         # replay down the target branch (deepest ancestor first)
         for h in reversed(chain):
             self.trie.checkpoint()
             self.trie.update(self._batch[h])
+            self._dirty_since_export = True
             root = self.ex.root_bytes(self.trie.commit_resident(self.ex))
             if root != self._roots[h]:
                 self.trie.rollback()  # close the scope we just opened

@@ -58,6 +58,10 @@ class Config:
     # --- device hashing ---------------------------------------------------
     # "auto": large dirty sets drain to the device keccak; "off": CPU only
     device_hasher: str = "auto"
+    # device-resident account trie: block commits run as resident device
+    # commits on the account-trie mirror (trie/resident_mirror.py);
+    # requires the native incremental planner (silent fallback otherwise)
+    resident_account_trie: bool = False
 
     # --- tx pool ----------------------------------------------------------
     local_txs_enabled: bool = False
@@ -125,6 +129,12 @@ class Config:
             )
         if self.device_hasher not in ("auto", "planned", "batched", "fused", "off"):
             raise ValueError(f"unknown device-hasher mode {self.device_hasher!r}")
+        if self.resident_account_trie and not self.pruning_enabled:
+            raise ValueError(
+                "resident-account-trie requires pruning: interval "
+                "persistence cannot honor the archival every-block-on-disk "
+                "guarantee"
+            )
 
 
 def parse_config(config_bytes: bytes) -> Config:

@@ -55,6 +55,9 @@ class VMConfig:
     # "auto"/"batched": drain large dirty sets to the device keccak from
     # Trie.hash (trie/trie.go:618-619 parallel-threshold analog); "off": CPU
     device_hasher: str = "auto"
+    # device-resident account trie (CacheConfig.resident_account_trie):
+    # per-block account hashing as one resident commit on the mirror
+    resident_account_trie: bool = False
 
 
 @dataclass
@@ -99,6 +102,7 @@ class VM:
                 commit_interval=full.commit_interval,
                 mempool_size=full.tx_pool_global_slots,
                 device_hasher=full.device_hasher,
+                resident_account_trie=full.resident_account_trie,
             )
         else:
             from .config import Config as FullConfig
@@ -162,6 +166,7 @@ class VM:
                 pruning=self.config.pruning,
                 commit_interval=self.config.commit_interval,
                 device_hasher=self.config.device_hasher,
+                resident_account_trie=self.config.resident_account_trie,
                 snapshot_limit=self.config.snapshot_limit,
                 trie_dirty_limit=full.trie_dirty_cache * 1024 * 1024,
                 accepted_cache_size=full.accepted_cache_size,

@@ -111,6 +111,79 @@ def test_exported_nodes_resolve_from_root():
     assert seen == set(db), "every exported node reachable from the root"
 
 
+def test_export_delta_overlay_completeness():
+    """Delta exports only nodes re-hashed since the previous export, and
+    disk = (previous image + delta) is a complete hashdb overlay for the
+    new root (reference trie/triedb/hashdb Commit semantics)."""
+    rng = random.Random(13)
+    state = _items(rng, 400)
+    t = IncrementalTrie(sorted(state.items()))
+    t.commit_cpu()
+    d0, b0, o0 = t.export_nodes()  # full image clears pending deltas
+    assert t.export_nodes(delta=True)[0].shape[0] == 0
+
+    keys = list(state)
+    t.update([(keys[i], rng.randbytes(40)) for i in range(0, 60, 2)])
+    root2 = t.commit_cpu()
+    d1, b1, o1 = t.export_nodes(delta=True)
+    assert 0 < d1.shape[0] < d0.shape[0]
+    # digest-exact
+    for i in range(d1.shape[0]):
+        assert keccak256(b1[int(o1[i]):int(o1[i + 1])]) == d1[i].tobytes()
+    # overlay completeness: walk root2 through old image + delta
+    db = {d0[i].tobytes(): b0[int(o0[i]):int(o0[i + 1])]
+          for i in range(d0.shape[0])}
+    db.update({d1[i].tobytes(): b1[int(o1[i]):int(o1[i + 1])]
+               for i in range(d1.shape[0])})
+
+    from coreth_tpu import rlp
+
+    def refs_of(items):
+        """Child references of a decoded node; a LEAF's second item is a
+        value (which can itself be 32 bytes long), not a reference —
+        the hex-prefix flag (0x20) distinguishes it."""
+        if len(items) == 17:
+            return items[:16]
+        if items[0] and items[0][0] & 0x20:
+            return []  # leaf
+        return [items[1]]
+
+    def walk(ref):
+        assert ref in db, "missing node in overlay"
+        stack = list(refs_of(rlp.decode(db[ref])))
+        while stack:
+            c = stack.pop()
+            if isinstance(c, bytes) and len(c) == 32:
+                walk(c)
+            elif isinstance(c, list):
+                stack.extend(refs_of(c))
+
+    walk(root2)
+    # a second delta is empty until something changes again
+    assert t.export_nodes(delta=True)[0].shape[0] == 0
+
+
+def test_export_delta_after_rollback_stays_consistent():
+    """Rollback replays through the updater, so rolled-back paths re-hash
+    and re-export: the overlay still resolves the restored root."""
+    rng = random.Random(14)
+    state = _items(rng, 200)
+    t = IncrementalTrie(sorted(state.items()))
+    root1 = t.commit_cpu()
+    d0, b0, o0 = t.export_nodes()
+    keys = list(state)
+    t.checkpoint()
+    t.update([(keys[0], b"speculative"), (keys[1], b"")])
+    t.commit_cpu()
+    t.rollback()
+    root_back = t.commit_cpu()
+    assert root_back == root1
+    d1, b1, o1 = t.export_nodes(delta=True)
+    for i in range(d1.shape[0]):
+        enc = b1[int(o1[i]):int(o1[i + 1])]
+        assert keccak256(enc) == d1[i].tobytes()
+
+
 def test_absorb_store_syncs_resident_digests():
     rng = random.Random(12)
     state = _items(rng, 250)

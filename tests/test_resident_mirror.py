@@ -54,16 +54,28 @@ def test_linear_chain_with_finality_flush():
     assert m.root_of(m.GENESIS) == _oracle(genesis)
 
     state = genesis
-    for i in range(6):
+    m.TIP_BUFFER = 4  # window semantics, not size: keep the test light
+    n_blocks = m.TIP_BUFFER + 6
+    roots = {}
+    for i in range(n_blocks):
         h = bytes([i + 1]) * 32
         parent = m.head
         batch = _batch(rng, state, 30)
         state = _apply(state, batch)
         root = m.verify(parent, h, batch)
         assert root == _oracle(state), f"block {i}"
+        roots[h] = root
         m.accept(h)
-        # steady state: the journal flushed, applied stack is just head
-        assert m.head == h and len(m._applied) == 1
+        assert m.head == h
+        # steady state: finalized history deeper than the tip buffer
+        # flushes; the stack stays a rolling TIP_BUFFER+1 window
+        assert len(m._applied) <= m.TIP_BUFFER + 1
+    # blocks beyond the window are forgotten, recent ones retained
+    assert m.root_of(bytes([1]) * 32) is None
+    recent = bytes([n_blocks - 1]) * 32  # one behind head
+    assert m.root_of(recent) == roots[recent]
+    # and their state is still readable (tip-buffer rewind)
+    assert m.read(roots[recent], next(iter(state))) is not None
 
 
 def test_sibling_competition_and_reorg():
@@ -130,17 +142,31 @@ def test_reject_applied_branch_rewinds():
         _oracle(_apply(genesis, batch1b))
 
 
-def test_finality_violation_detected():
+def test_flushed_history_is_final():
+    """Below the tip buffer, finalized history loses its records: a
+    sibling branching there is refused (within the buffer, accepted
+    blocks stay rewindable for reads — reference tip-buffer semantics)."""
     rng = random.Random(44)
     genesis = _rand_items(rng, 100)
     m = ResidentAccountMirror(sorted(genesis.items()))
-    b1 = b"\x01" * 32
-    batch1 = _batch(rng, genesis, 10)
-    m.verify(m.GENESIS, b1, batch1)
-    m.accept(b1)  # flushes: applied == [b1]
-    # a sibling of b1 would need to rewind an accepted block
+    m.TIP_BUFFER = 4  # window semantics, not size: keep the test light
+    state = genesis
+    for i in range(m.TIP_BUFFER + 2):
+        h = bytes([i + 1]) * 32
+        batch = _batch(rng, state, 10)
+        state = _apply(state, batch)
+        m.verify(m.head, h, batch)
+        m.accept(h)
+    # genesis is beyond the retained window now
     with pytest.raises(MirrorError, match="unknown parent"):
         m.verify(m.GENESIS, b"\x0f" * 32, [])
+    # a sibling of a RETAINED accepted block applies mechanically
+    # (consensus will reject it; the mirror just serves its state)
+    parent = bytes([m.TIP_BUFFER]) * 32
+    sib = b"\xee" * 32  # distinct from every bytes([i+1])*32 block hash
+    sib_root = m.verify(parent, sib, [])
+    assert sib_root == m.root_of(parent)
+    m.reject(sib)
 
 
 def test_unknown_parent_rejected():
