@@ -180,20 +180,20 @@ class ResidentTrieWriter(TrieWriter):
     def _export(self, block) -> None:
         from ..trie.resident_mirror import MirrorError
 
-        batch = self.db.diskdb.new_batch()
         try:
-            self.mirror.export_to(batch.put, at_block=block.hash())
+            # pre_write flushes storage-trie nodes BEFORE the account
+            # batch whose root node makes has_state() true — a crash
+            # between the writes must leave a root that either fully
+            # resolves or triggers reprocess_state, never a root with
+            # missing storage subtrees (triedb._commit_walk's
+            # children-first ordering); export_to owns the batch so a
+            # failed write degrades the next export to a full image
+            self.mirror.export_to(
+                self.db.diskdb, at_block=block.hash(),
+                pre_write=lambda: self.db.cap(0))
         except MirrorError:
             return  # block already beyond the rewind horizon; the next
             #         boundary export covers its nodes
-        # children-first crash ordering: storage-trie nodes land BEFORE
-        # the account batch whose root node makes has_state() true — a
-        # crash between the writes must leave a root that either fully
-        # resolves or triggers reprocess_state, never a root with
-        # missing storage subtrees (same ordering triedb._commit_walk
-        # guarantees)
-        self.db.cap(0)
-        batch.write()
 
     def shutdown(self) -> None:
         if self._last_accepted is not None:

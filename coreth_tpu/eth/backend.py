@@ -215,9 +215,7 @@ class EthBackend:
                 key = mirror.key_for_root(blk.root)
                 if key is None:  # pruned between open_trie and here
                     raise MirrorError("root left the resident window")
-                batch = self.chain.diskdb.new_batch()
-                mirror.export_to(batch.put, at_block=key)
-                batch.write()
+                mirror.export_to(self.chain.diskdb, at_block=key)
             except MirrorError as e:
                 raise RPCError(-32000, f"state unavailable: {e}")
             state_trie = self.chain.state_database.triedb.open_state_trie(

@@ -132,15 +132,27 @@ class ResidentExecutor:
     digest cache. seg_impl: optional keccak kernel override (the Pallas
     kernel plugs in, as in ops/keccak_planned.py)."""
 
-    def __init__(self, seg_impl=None):
+    def __init__(self, seg_impl=None, sharding=None):
         impl = seg_impl if seg_impl is not None else _segment_keccak
         self._step = _make_res_step(impl)
         self.store: Optional[jax.Array] = None
         self.arenas: dict[int, jax.Array] = {}
         self.last_root: Optional[jax.Array] = None  # uint32[8], lazy
         self._owner = None  # weakref to the one trie this store serves
+        # multichip: a NamedSharding over the ROW axis (store slots /
+        # arena rows) distributes the resident state across a Mesh —
+        # capacities round up to the device count and GSPMD partitions
+        # the step's gathers/scatters (parallel.resident_executor_over_
+        # mesh builds this; dig stays replicated, it is per-commit-sized)
+        self.sharding = sharding
+        self._row_mult = sharding.mesh.size if sharding is not None else 1
         # diagnostics for PERF.md / bench: bytes actually shipped
         self.h2d_bytes = 0
+
+    def _pin(self, arr: jax.Array) -> jax.Array:
+        if self.sharding is None:
+            return arr
+        return jax.device_put(arr, self.sharding)
 
     # ---- ownership: slot/row numbering is per-trie, so a second trie
     # sharing this executor would silently corrupt both stores ----
@@ -161,25 +173,30 @@ class ResidentExecutor:
 
     # ---- capacity management (growth recompiles; keep it geometric) ----
 
+    def _cap(self, n: int) -> int:
+        m = self._row_mult
+        return -(-n // m) * m
+
     def _ensure_store(self, slots_needed: int):
         if self.store is None:
-            cap = max(2 * slots_needed, 4096)
-            self.store = jnp.zeros((cap, 8), jnp.uint32)
+            cap = self._cap(max(2 * slots_needed, 4096))
+            self.store = self._pin(jnp.zeros((cap, 8), jnp.uint32))
         elif self.store.shape[0] < slots_needed:
-            cap = max(2 * slots_needed, 2 * self.store.shape[0])
+            cap = self._cap(max(2 * slots_needed, 2 * self.store.shape[0]))
             pad = jnp.zeros((cap - self.store.shape[0], 8), jnp.uint32)
-            self.store = jnp.concatenate([self.store, pad], axis=0)
+            self.store = self._pin(
+                jnp.concatenate([self.store, pad], axis=0))
 
     def _ensure_arena(self, cls: int, rows_needed: int):
         width = cls * 34
         a = self.arenas.get(cls)
         if a is None:
-            cap = max(2 * rows_needed, 1024)
-            self.arenas[cls] = jnp.zeros((cap, width), jnp.uint32)
+            cap = self._cap(max(2 * rows_needed, 1024))
+            self.arenas[cls] = self._pin(jnp.zeros((cap, width), jnp.uint32))
         elif a.shape[0] < rows_needed:
-            cap = max(2 * rows_needed, 2 * a.shape[0])
+            cap = self._cap(max(2 * rows_needed, 2 * a.shape[0]))
             pad = jnp.zeros((cap - a.shape[0], width), jnp.uint32)
-            self.arenas[cls] = jnp.concatenate([a, pad], axis=0)
+            self.arenas[cls] = self._pin(jnp.concatenate([a, pad], axis=0))
 
     # ---- one commit ----
 

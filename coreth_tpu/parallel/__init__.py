@@ -214,3 +214,29 @@ def planned_commit_over_mesh(mesh: Mesh, axis: str = "batch"):
         runner = PlannedCommit(seg_impl=sharded_seg_impl(mesh, axis))
         _planned_by_mesh[key] = runner
     return runner
+
+
+def resident_executor_over_mesh(mesh: Mesh, axis: str = "batch",
+                                seg_impl=None):
+    """A ResidentExecutor whose device-resident state (digest store +
+    row arenas) is SHARDED across [mesh] on the row axis — the
+    multichip form of the deferred-absorb design: each device holds
+    1/N of every arena class and of the digest store, so resident
+    memory capacity and fresh-row upload bandwidth scale with the mesh
+    (each host feeds its own chips' row shards over its own PCI/ICI
+    link in a pod).
+
+    Partitioning is GSPMD-driven: the step's row gathers, delta
+    scatter-adds, and store scatters run over the sharded operands with
+    XLA inserting the collectives; the per-commit dig matrix stays
+    replicated (it is small and every later segment's patches may read
+    any earlier lane). One executor per trie, as in the single-chip
+    case. Validated on the virtual CPU mesh by __graft_entry__.
+    dryrun_multichip's resident leg (root parity vs the host oracle
+    across churn + rollback rounds)."""
+    from ..ops.keccak_resident import ResidentExecutor
+
+    return ResidentExecutor(
+        seg_impl=seg_impl,
+        sharding=NamedSharding(mesh, P(axis, None)),
+    )

@@ -87,6 +87,7 @@ class ResidentAccountMirror:
         self._applied: List[bytes] = [base]
         self._accepted: set = {base}
         self._dirty_since_export = True  # genesis image not yet on disk
+        self._export_degraded = False    # failed write -> next export full
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -402,20 +403,29 @@ class ResidentAccountMirror:
     # ---- interval persistence (disk flush of changed nodes) --------------
 
     @_locked
-    def export_to(self, put, at_block: Optional[bytes] = None) -> int:
-        """Write every account-trie node changed since the previous
-        export to [put(digest32, rlp_blob)] — the commit-interval disk
-        flush (reference trie/triedb/hashdb Commit via
+    def export_to(self, diskdb, at_block: Optional[bytes] = None,
+                  pre_write=None) -> int:
+        """Durably write every account-trie node changed since the
+        previous export into [diskdb] — the commit-interval disk flush
+        (reference trie/triedb/hashdb Commit via
         core/state_manager.go:153). Positions the trie at [at_block]
         (typically the just-accepted block) first so the on-disk image is
-        complete for that block's root. Returns nodes written.
+        complete for that block's root; [pre_write] (e.g. the storage-
+        forest cap) runs after the batch is staged but before it commits,
+        preserving children-first crash ordering. Returns nodes written.
+
+        Durability: the native export clears its changed-node marks as it
+        walks, so a FAILED disk write would silently drop those nodes
+        from every later delta. On any write failure the next export
+        degrades to a FULL image (which supersedes all lost deltas)
+        before the marks are trusted again.
 
         Content-addressed writes make sibling/abandoned-branch nodes
         harmless on disk: they are unreachable garbage the offline
         pruner sweeps, exactly like the reference's stale hashdb nodes."""
         import numpy as np
 
-        if not self._dirty_since_export and (
+        if not self._dirty_since_export and not self._export_degraded and (
             at_block is None or self._applied[-1] == at_block
         ):
             # nothing re-hashed since the last export at this position:
@@ -434,11 +444,22 @@ class ResidentAccountMirror:
         self.trie.commit_resident(self.ex)
         self.trie.absorb_store(np.asarray(self.ex.store))
         try:
-            digs, blob, off = self.trie.export_nodes(delta=True)
+            digs, blob, off = self.trie.export_nodes(
+                delta=not self._export_degraded)
         except RuntimeError as e:  # dirty-trie guard: surface as ours
             raise MirrorError(f"export on unsettled trie: {e}")
-        for i in range(digs.shape[0]):
-            put(digs[i].tobytes(), blob[int(off[i]):int(off[i + 1])])
+        try:
+            batch = diskdb.new_batch()
+            for i in range(digs.shape[0]):
+                batch.put(digs[i].tobytes(), blob[int(off[i]):int(off[i + 1])])
+            if pre_write is not None:
+                pre_write()
+            batch.write()
+        except BaseException:
+            self._export_degraded = True
+            self._dirty_since_export = True
+            raise
+        self._export_degraded = False
         self._dirty_since_export = False
         return int(digs.shape[0])
 

@@ -227,6 +227,100 @@ class TestResidentPersistence:
         chain.stop()
 
 
+class TestResidentCrashRecovery:
+    def test_unclean_shutdown_reprocesses_tail(self):
+        """Crash mid-interval (no shutdown export): boot finds the tip
+        state missing, re-executes from the last exported root through
+        the DEFAULT path, then installs the mirror over the healed tip
+        (blockchain.go:679,1745 loadLastState -> reprocessState)."""
+        diskdb = MemoryDB()
+        chain = make_chain(diskdb=diskdb, commit_interval=3)
+        counts = {}
+        blocks = build_blocks(chain, 5, tx_gen(counts))
+        for b in blocks:
+            chain.insert_block(b)
+            chain.accept(b)
+        chain.drain_acceptor_queue()
+        assert chain.acceptor_error is None
+        tip = chain.last_accepted
+        # simulate a crash: no chain.stop(), so no shutdown export —
+        # disk has the interval export at block 3 plus block bodies
+        chain._acceptor_queue.put(None)
+
+        reopened = make_chain(diskdb=diskdb, commit_interval=3)
+        assert reopened.last_accepted.hash() == tip.hash()
+        assert reopened.state_database.mirror is not None
+        st = reopened.state()
+        assert st.get_balance(ADDR2) == FUND + sum(1000 + i for i in range(5))
+        assert st.get_nonce(ADDR1) == 5
+        # the healed chain keeps extending through the mirror
+        more = build_blocks(reopened, 2, tx_gen(counts))
+        for b in more:
+            reopened.insert_block(b)
+            reopened.accept(b)
+        reopened.drain_acceptor_queue()
+        assert reopened.acceptor_error is None
+        assert reopened.state().get_nonce(ADDR1) == 7
+        reopened.stop()
+
+
+class TestResidentReorgFuzz:
+    def test_random_fork_lifecycle_matches_default(self):
+        """Randomized fork/accept/reject rounds driven identically into a
+        resident chain and a default-path chain: every accepted head's
+        state must agree (insert itself enforces root==header.root, so
+        any divergence in the mirror's rewind/replay surfaces here)."""
+        import random as _random
+
+        rng = _random.Random(1234)
+        resident = make_chain()
+        default = make_chain(resident=False)
+        base = params.APRICOT_PHASE3_INITIAL_BASE_FEE
+        nonces = {ADDR1: 0, ADDR2: 0}
+
+        def fork(chain, parent, sender_key, sender, value):
+            def gen(i, bg):
+                bg.add_tx(transfer_tx(
+                    nonces[sender], ADDR2 if sender == ADDR1 else ADDR1,
+                    sender_key, bg.base_fee() or base, value=value))
+
+            blocks, _ = generate_chain(
+                chain.config, parent, chain.engine,
+                chain.state_database, 1, gen=gen)
+            return blocks[0]
+
+        for rnd in range(8):
+            # two competing children of the current head, different txs
+            parent_r = resident.last_accepted
+            parent_d = default.last_accepted
+            assert parent_r.hash() == parent_d.hash()
+            val_a, val_b = 100 + rnd, 200 + rnd
+            key, sender = ((KEY1, ADDR1) if rng.random() < 0.5
+                           else (KEY2, ADDR2))
+            blk_a = fork(default, parent_d, key, sender, val_a)
+            blk_b = fork(default, parent_d, key, sender, val_b)
+            for chain in (resident, default):
+                chain.insert_block_manual(blk_a, writes=True)
+                chain.insert_block_manual(blk_b, writes=True)
+            # both sibling states readable on the resident chain
+            assert resident.state_at(blk_a.root).get_balance(
+                ADDR2) == default.state_at(blk_a.root).get_balance(ADDR2)
+            winner, loser = ((blk_a, blk_b) if rng.random() < 0.5
+                             else (blk_b, blk_a))
+            for chain in (resident, default):
+                chain.accept(winner)
+                chain.drain_acceptor_queue()
+                assert chain.acceptor_error is None, chain.acceptor_error
+                chain.reject(loser)
+            nonces[sender] += 1
+            s_r, s_d = resident.state(), default.state()
+            for addr in (ADDR1, ADDR2):
+                assert s_r.get_balance(addr) == s_d.get_balance(addr), rnd
+                assert s_r.get_nonce(addr) == s_d.get_nonce(addr), rnd
+        resident.stop()
+        default.stop()
+
+
 class TestResidentVM:
     def test_vm_end_to_end_with_proof(self):
         """The VM knob (config.go-style JSON -> resident-account-trie)

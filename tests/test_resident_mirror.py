@@ -169,6 +169,48 @@ def test_flushed_history_is_final():
     m.reject(sib)
 
 
+def test_failed_export_write_degrades_to_full_image():
+    """The native delta export clears its changed-node marks as it
+    walks, so a failed disk write must NOT lose those nodes: the next
+    export degrades to a full image that supersedes the lost delta."""
+    from coreth_tpu.ethdb import MemoryDB
+
+    rng = random.Random(46)
+    genesis = _rand_items(rng, 120)
+    m = ResidentAccountMirror(sorted(genesis.items()))
+    db = MemoryDB()
+    n0 = m.export_to(db)
+    assert n0 > 0
+
+    keys = list(genesis)
+    m.verify(m.head, b"\x01" * 32, [(keys[0], b"changed")])
+
+    class FailingBatch:
+        def put(self, k, v):
+            pass
+
+        def write(self):
+            raise OSError("disk full")
+
+    class FailAtWrite:
+        def new_batch(self):
+            return FailingBatch()
+
+    with pytest.raises(OSError):
+        m.export_to(FailAtWrite())
+    # repair: the next (successful) export is a FULL image — every node
+    # of the current tree lands, including the ones whose marks the
+    # failed export consumed
+    db2 = MemoryDB()
+    n_repair = m.export_to(db2)
+    assert n_repair >= n0, (n_repair, n0)
+    # and the current root's node is present in the repaired image
+    root = m.root_of(b"\x01" * 32)
+    assert db2.get(root) is not None
+    # afterwards deltas are trusted again (nothing changed -> no-op)
+    assert m.export_to(MemoryDB()) == 0
+
+
 def test_unknown_parent_rejected():
     rng = random.Random(45)
     m = ResidentAccountMirror(sorted(_rand_items(rng, 50).items()))
