@@ -389,12 +389,26 @@ class StateDB:
 
         self.finalise(delete_empty)
         marker = getattr(self.db.triedb, "batch_keccak", None)
-        # resident mode: the facade buffers account writes and previews
-        # the root through the mirror — the plain loop below IS the
-        # resident path; the planned graph builder (which walks Python
-        # account-trie nodes this StateDB doesn't have) must not engage
-        if not getattr(self.trie, "resident", False) and getattr(
-                marker, "planned", False):
+        resident = getattr(self.trie, "resident", False)
+        if resident and getattr(marker, "planned", False):
+            # resident mode: the account trie rides the mirror, but a
+            # block's dirty STORAGE tries can still batch into one
+            # planned device program (their roots land in the account
+            # RLP the mirror batch carries) — the storage half of
+            # statedb.go:1040-1160's ordering, device-side
+            est = sum(
+                len(self._objects[a].pending_storage)
+                for a in self._objects_pending
+                if not self._objects[a].deleted
+            )
+            from ..trie.hasher import BATCH_THRESHOLD
+
+            if est >= BATCH_THRESHOLD:
+                self._batch_storage_roots()
+        # default mode: the planned graph builder walks Python account-
+        # trie nodes (which a resident StateDB doesn't have), hashing
+        # storage tries AND the account trie in one program
+        if not resident and getattr(marker, "planned", False):
             est = len(self._objects_pending) + sum(
                 len(self._objects[a].pending_storage)
                 for a in self._objects_pending
@@ -417,6 +431,40 @@ class StateDB:
         self._objects_pending = set()
         with expensive_timer("state/account/hashes"):
             return self.trie.hash()
+
+    def _batch_storage_roots(self) -> None:
+        """One planned device program over every dirty storage trie (no
+        account trie — that is the mirror's). On success each trie's
+        nodes carry their hashes and obj.data.root is real, so the plain
+        update loop's update_root() is a cache hit. Unlike the full
+        planned path there are no zeroed holes to heal: any failure
+        leaves the tries untouched and the per-trie hashers take over."""
+        from ..trie.node import FullNode, ShortNode
+        from ..trie.planned import PlannedGraphBuilder, TooManySegments
+
+        builder = PlannedGraphBuilder()
+        pending = []
+        for addr in sorted(self._objects_pending):
+            obj = self._objects[addr]
+            if obj.deleted:
+                continue
+            tr = obj.update_trie()
+            inner = tr.trie if tr is not None else None
+            if (
+                inner is not None
+                and isinstance(inner.root, (ShortNode, FullNode))
+                and inner.root.flags.hash is None
+            ):
+                pending.append((obj, builder.add_trie(inner.root), tr))
+        if not pending:
+            return
+        try:
+            builder.run()
+        except TooManySegments:
+            return  # per-trie hashers cover the pathological shape
+        for obj, handle, tr in pending:
+            obj.data.root = builder.digest(handle)
+            tr.trie.unhashed = 0
 
     def _planned_intermediate_root(self) -> bytes:
         """One planned device program for the whole block commit.

@@ -227,6 +227,157 @@ class TestResidentPersistence:
         chain.stop()
 
 
+class TestResidentStorageContracts:
+    def test_storage_heavy_blocks_match_default(self):
+        """Blocks that create dirty STORAGE tries (contract deployments
+        SSTOREing several slots) through the resident path: account roots
+        come from the mirror while storage tries ride the normal
+        committer — roots, storage reads, and receipts must match the
+        default path block for block."""
+        from coreth_tpu.core.types import create_address
+
+        n_senders = 24
+        keys = [i.to_bytes(1, "big") * 32 for i in range(1, n_senders + 1)]
+        addrs = [priv_to_address(k) for k in keys]
+        base = params.APRICOT_PHASE3_INITIAL_BASE_FEE
+        signer = Signer(43112)
+
+        def storage_init_code(seed: int) -> bytes:
+            code = bytearray()
+            for s in range(6):
+                v = (seed * 31 + s * 7 + 1) % 256 or 1
+                code += bytes([0x60, v, 0x60, s, 0x55])
+            code += bytes([0x60, 0x00, 0x60, 0x00, 0xF3])
+            return bytes(code)
+
+        def build(resident):
+            diskdb = MemoryDB()
+            genesis = Genesis(
+                config=params.TEST_CHAIN_CONFIG,
+                gas_limit=params.CORTINA_GAS_LIMIT,
+                alloc={a: GenesisAccount(balance=FUND) for a in addrs},
+            )
+            return BlockChain(
+                diskdb,
+                CacheConfig(pruning=True, resident_account_trie=resident),
+                params.TEST_CHAIN_CONFIG, genesis, new_dummy_engine(),
+                state_database=Database(TrieDatabase(diskdb)),
+            )
+
+        default = build(False)
+        resident = build(True)
+        assert resident.state_database.mirror is not None
+
+        def gen(i, bg):
+            bf = bg.base_fee() or base
+            for j in range(n_senders):
+                tx = Transaction(
+                    type=2, chain_id=43112, nonce=i, max_fee=bf * 2,
+                    max_priority_fee=0, gas=200_000, to=None, value=0,
+                    data=storage_init_code(i * n_senders + j),
+                )
+                bg.add_tx(signer.sign(tx, keys[j]))
+
+        blocks, _ = generate_chain(
+            default.config, default.current_block, default.engine,
+            default.state_database, 2, gen=gen)
+        for b in blocks:
+            default.insert_block(b)   # root check inside
+            resident.insert_block(b)  # raises on any mirror root mismatch
+            default.accept(b)
+            resident.accept(b)
+        default.drain_acceptor_queue()
+        resident.drain_acceptor_queue()
+        assert resident.acceptor_error is None
+
+        s_def, s_res = default.state(), resident.state()
+        for j in range(n_senders):
+            caddr = create_address(addrs[j], 0)
+            for slot in range(6):
+                k = slot.to_bytes(32, "big")
+                assert s_res.get_state(caddr, k) == s_def.get_state(
+                    caddr, k), (j, slot)
+        default.stop()
+        resident.stop()
+
+
+class TestResidentStorageBatch:
+    def test_storage_tries_batch_into_one_planned_program(self, monkeypatch):
+        """With the planned device marker installed, a resident block's
+        dirty storage tries hash in ONE planned program (storage-only —
+        the account trie rides the mirror), and the roots still match
+        the headers produced by the default path."""
+        from coreth_tpu.ops.device import get_batch_keccak
+        from coreth_tpu.trie import planned as planned_mod
+
+        runs = {"n": 0, "account": 0}
+        orig = planned_mod.PlannedGraphBuilder.run
+
+        def counted(selfb, *a, **kw):
+            runs["n"] += 1
+            if selfb._account is not None:
+                runs["account"] += 1
+            return orig(selfb, *a, **kw)
+
+        monkeypatch.setattr(planned_mod.PlannedGraphBuilder, "run", counted)
+
+        n_senders = 24
+        keys = [i.to_bytes(1, "big") * 32 for i in range(1, n_senders + 1)]
+        addrs = [priv_to_address(k) for k in keys]
+        signer = Signer(43112)
+        base = params.APRICOT_PHASE3_INITIAL_BASE_FEE
+
+        def storage_init_code(seed: int) -> bytes:
+            code = bytearray()
+            for s in range(6):
+                v = (seed * 31 + s * 7 + 1) % 256 or 1
+                code += bytes([0x60, v, 0x60, s, 0x55])
+            code += bytes([0x60, 0x00, 0x60, 0x00, 0xF3])
+            return bytes(code)
+
+        genesis_alloc = {a: GenesisAccount(balance=FUND) for a in addrs}
+
+        def build(resident):
+            diskdb = MemoryDB()
+            genesis = Genesis(
+                config=params.TEST_CHAIN_CONFIG,
+                gas_limit=params.CORTINA_GAS_LIMIT, alloc=genesis_alloc)
+            marker = get_batch_keccak("planned") if resident else None
+            return BlockChain(
+                diskdb,
+                CacheConfig(pruning=True, resident_account_trie=resident),
+                params.TEST_CHAIN_CONFIG, genesis, new_dummy_engine(),
+                state_database=Database(
+                    TrieDatabase(diskdb, batch_keccak=marker)),
+            )
+
+        default = build(False)
+        resident = build(True)
+
+        def gen(i, bg):
+            bf = bg.base_fee() or base
+            for j in range(n_senders):
+                tx = Transaction(
+                    type=2, chain_id=43112, nonce=i, max_fee=bf * 2,
+                    max_priority_fee=0, gas=200_000, to=None, value=0,
+                    data=storage_init_code(i * n_senders + j),
+                )
+                bg.add_tx(signer.sign(tx, keys[j]))
+
+        blocks, _ = generate_chain(
+            default.config, default.current_block, default.engine,
+            default.state_database, 1, gen=gen)
+        resident.insert_block(blocks[0])  # root check inside
+        assert runs["n"] >= 1, "storage batch program never ran"
+        assert runs["account"] == 0, (
+            "resident mode must not build an account-trie planned program")
+        resident.accept(blocks[0])
+        resident.drain_acceptor_queue()
+        assert resident.acceptor_error is None
+        default.stop()
+        resident.stop()
+
+
 class TestResidentCrashRecovery:
     def test_unclean_shutdown_reprocesses_tail(self):
         """Crash mid-interval (no shutdown export): boot finds the tip
