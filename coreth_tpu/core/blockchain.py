@@ -185,22 +185,7 @@ class BlockChain:
             from ..native.mpt import load_inc
 
             if load_inc() is not None:
-                from ..trie.iterator import iterate_leaves
-                from ..trie.resident_mirror import ResidentAccountMirror
-
-                tr = self.state_database.triedb.open_trie(
-                    self.last_accepted.root)
-                self.mirror = ResidentAccountMirror(
-                    list(iterate_leaves(tr)),
-                    base_key=self.last_accepted.hash(),
-                )
-                self.state_database.mirror = self.mirror
-                self.trie_writer = ResidentTrieWriter(
-                    self.state_database.triedb,
-                    self.mirror,
-                    commit_interval=cache_config.commit_interval,
-                    memory_cap=cache_config.trie_dirty_limit,
-                )
+                self._boot_mirror()
 
         # flat snapshot tree over the last-accepted state (snapshot_limit
         # gates it, like CacheConfig.SnapshotLimit in the reference)
@@ -350,6 +335,37 @@ class BlockChain:
 
     def has_block(self, block_hash: bytes) -> bool:
         return self.get_block(block_hash) is not None
+
+    def _boot_mirror(self) -> None:
+        """(Re)build the resident account mirror over the last-accepted
+        state: one ordered leaf scan of its (on-disk) account trie, then
+        route the trie lifecycle through it."""
+        from ..trie.iterator import iterate_leaves
+        from ..trie.resident_mirror import ResidentAccountMirror
+
+        tr = self.state_database.triedb.open_state_trie(
+            self.last_accepted.root).trie
+        self.mirror = ResidentAccountMirror(
+            list(iterate_leaves(tr)),
+            base_key=self.last_accepted.hash(),
+        )
+        self.state_database.mirror = self.mirror
+        self.trie_writer = ResidentTrieWriter(
+            self.state_database.triedb,
+            self.mirror,
+            commit_interval=self.cache_config.commit_interval,
+            memory_cap=self.cache_config.trie_dirty_limit,
+        )
+
+    def reboot_mirror(self) -> None:
+        """Rebuild the mirror after the chain's state was replaced out of
+        band (state sync landing on a far-future root — the analog of
+        blockchain.go:2051 ResetToStateSyncedBlock re-opening state): the
+        old mirror's base is the pre-sync state and can never reach the
+        synced root by replay. No-op when resident mode is off."""
+        if self.mirror is None:
+            return
+        self._boot_mirror()
 
     def has_state(self, root: bytes) -> bool:
         from ..trie.node import EMPTY_ROOT

@@ -63,6 +63,26 @@ class StateSyncClient:
         to completion (the reference does this on a goroutine; callers may
         wrap this in a thread)."""
         diskdb = self.vm.blockchain.diskdb
+        if diskdb.get(SYNC_SUMMARY_KEY) is None:
+            # FRESH sync (not a resume — a resume's markered ranges wrote
+            # their snapshot entries already and must keep them): wipe
+            # pre-sync flat-snapshot entries so keys that exist locally
+            # but not in the synced state can never survive as phantoms
+            # (the reference resets snapshot generation on sync start)
+            from ..state.snapshot import (
+                SNAPSHOT_ACCOUNT_PREFIX,
+                SNAPSHOT_STORAGE_PREFIX,
+            )
+
+            batch = diskdb.new_batch()
+            # exact schema lengths only: hash-keyed trie nodes (32 B) and
+            # other rawdb keys can share a first byte with these prefixes
+            for prefix, klen in ((SNAPSHOT_ACCOUNT_PREFIX, 33),
+                                 (SNAPSHOT_STORAGE_PREFIX, 65)):
+                for k, _v in diskdb.iterate(prefix):
+                    if len(k) == klen:
+                        batch.delete(k)
+            batch.write()
         diskdb.put(SYNC_SUMMARY_KEY, summary.encode())
         self.state_sync(summary)
         diskdb.delete(SYNC_SUMMARY_KEY)
@@ -139,6 +159,29 @@ class StateSyncClient:
         chain._canonical[blk.number] = blk.hash()
         chain.current_block = blk
         chain.last_accepted = blk
+        # resident mode: the mirror's base is the pre-sync state and can
+        # never reach the synced root by replay — reboot it over the
+        # freshly synced account trie so post-sync blocks verify through
+        # the device-resident path
+        chain.reboot_mirror()
+        # the flat snapshot was populated leaf by leaf during the trie
+        # sync; stamp the disk markers and re-anchor the layer tree at
+        # the synced block so post-sync commits build diff layers on it
+        # (the pre-sync tree is anchored at genesis — its layers can
+        # never parent a post-sync block's diff)
+        if chain.snaps is not None:
+            from ..state.snapshot import (
+                SNAPSHOT_BLOCK_HASH_KEY,
+                SNAPSHOT_ROOT_KEY,
+                Tree as SnapshotTree,
+            )
+
+            chain.diskdb.put(SNAPSHOT_ROOT_KEY, blk.root)
+            chain.diskdb.put(SNAPSHOT_BLOCK_HASH_KEY, blk.hash())
+            chain.snaps = SnapshotTree(
+                chain.diskdb, chain.state_database.triedb,
+                blk.root, block_hash=blk.hash(),
+            )
         from .block import BlockStatus, VMBlock
 
         vmb = VMBlock(self.vm, blk)

@@ -372,6 +372,80 @@ def test_two_vm_segmented_state_sync(monkeypatch):
     server.shutdown()
 
 
+def test_two_vm_sync_into_resident_client():
+    """State sync landing in a RESIDENT-mode client: after the synced
+    block is accepted, the mirror reboots over the synced root
+    (syncervm _finish -> chain.reboot_mirror) and subsequent blocks
+    verify through the device-resident path — including one mined by
+    the server and fed across."""
+    from test_sync import DEST, KEY, build_server_vm, wire_network
+
+    from coreth_tpu.core.genesis import GenesisAccount
+    from coreth_tpu.core.state_manager import ResidentTrieWriter
+    from coreth_tpu.core.types import Signer, Transaction
+    from coreth_tpu.native.mpt import load_inc
+    from coreth_tpu.vm.shared_memory import Memory
+    from coreth_tpu.vm.syncervm import StateSyncClient, StateSyncServer
+    from coreth_tpu.vm.vm import VM, SnowContext, VMConfig
+
+    if load_inc() is None:
+        pytest.skip("native incremental planner unavailable")
+
+    extra = {i.to_bytes(20, "big"): GenesisAccount(balance=10**12 + i)
+             for i in range(1, 1200)}
+    server, _mem = build_server_vm(n_blocks=4, txs_per_block=1,
+                                   extra_alloc=extra)
+    sync_server = StateSyncServer(server.blockchain, syncable_interval=4)
+    summary = sync_server.get_last_state_summary()
+    assert summary is not None
+
+    client_vm = VM()
+    client_vm.initialize(
+        SnowContext(shared_memory=Memory()), MemoryDB(),
+        server.test_genesis,
+        VMConfig(resident_account_trie=True))
+    assert client_vm.blockchain.mirror is not None
+    pre_sync_mirror = client_vm.blockchain.mirror
+    net = wire_network(server)
+    StateSyncClient(client_vm, SyncClient(net)).accept_summary(summary)
+
+    chain = client_vm.blockchain
+    assert chain.last_accepted.hash() == summary.block_hash
+    # mirror rebooted over the synced root
+    assert chain.mirror is not pre_sync_mirror
+    assert isinstance(chain.trie_writer, ResidentTrieWriter)
+    assert chain.mirror.root_of(summary.block_hash) == chain.last_accepted.root
+    # reads at the synced state go through the resident facade
+    tr = chain.state_database.open_trie(chain.last_accepted.root)
+    assert getattr(tr, "resident", False)
+    st = chain.state()
+    assert st.get_balance(DEST) == 4 * 1 * 3
+    assert st.get_balance((777).to_bytes(20, "big")) == 10**12 + 777
+
+    # the chain keeps extending through the mirror: the server mines one
+    # more block; the client parses, verifies, and accepts it
+    signer = Signer(43112)
+    t = Transaction(type=2, chain_id=43112, nonce=4, max_fee=10**12,
+                    max_priority_fee=10**9, gas=21000, to=DEST, value=3)
+    server.issue_tx(signer.sign(t, KEY))
+    blk = server.build_block()
+    blk.verify()
+    blk.accept()
+    server.blockchain.drain_acceptor_queue()
+
+    client_blk = client_vm.parse_block(blk.eth_block.encode())
+    client_blk.verify()
+    client_blk.accept()
+    chain.drain_acceptor_queue()
+    assert chain.acceptor_error is None
+    assert chain.last_accepted.hash() == blk.eth_block.hash()
+    assert chain.mirror.root_of(blk.eth_block.hash()) is not None, (
+        "post-sync block did not go through the mirror")
+    assert chain.state().get_balance(DEST) == 5 * 1 * 3
+    client_vm.shutdown()
+    server.shutdown()
+
+
 def _leaves(trie):
     from coreth_tpu.trie.iterator import iterate_leaves
 
