@@ -233,7 +233,13 @@ def resident_executor_over_mesh(mesh: Mesh, axis: str = "batch",
     any earlier lane). One executor per trie, as in the single-chip
     case. Validated on the virtual CPU mesh by __graft_entry__.
     dryrun_multichip's resident leg (root parity vs the host oracle
-    across churn + rollback rounds)."""
+    across churn + rollback rounds).
+
+    axis may be one mesh axis name or a tuple of names: on a 2-D
+    (host, chip) mesh (make_mesh_2d), axis=("host", "batch") shards
+    rows over every device — each host owns a contiguous row block, so
+    fresh-row uploads stay host-local and only digest traffic crosses
+    DCN."""
     from ..ops.keccak_resident import ResidentExecutor
 
     return ResidentExecutor(

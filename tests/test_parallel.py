@@ -159,6 +159,33 @@ def test_resident_executor_sharded_over_mesh():
     assert ex.root_bytes(dev.commit_resident(ex)) == oracle.commit_cpu()
 
 
+def test_resident_executor_sharded_over_2d_mesh():
+    """Resident state sharded over a (host, chip) mesh: rows partition
+    over BOTH axes (host-contiguous blocks), roots stay bit-exact."""
+    import random
+
+    from coreth_tpu.native.mpt import IncrementalTrie, load_inc
+    from coreth_tpu.parallel import make_mesh_2d, resident_executor_over_mesh
+
+    if load_inc() is None:
+        pytest.skip("native incremental planner unavailable")
+    rng = random.Random(33)
+    items = sorted(
+        {rng.randbytes(32): rng.randbytes(50) for _ in range(500)}.items())
+    keys = [k for k, _ in items]
+    mesh2d = make_mesh_2d(4, 2)
+    ex = resident_executor_over_mesh(mesh2d, axis=("host", "batch"))
+    dev = IncrementalTrie(items)
+    oracle = IncrementalTrie(items)
+    assert ex.root_bytes(dev.commit_resident(ex)) == oracle.commit_cpu()
+    assert len(ex.store.sharding.device_set) == 8
+    ups = [(keys[rng.randrange(len(keys))], rng.randbytes(40))
+           for _ in range(80)]
+    dev.update(ups)
+    oracle.update(ups)
+    assert ex.root_bytes(dev.commit_resident(ex)) == oracle.commit_cpu()
+
+
 def test_pallas_seg_impl_shards_structurally(mesh):
     """The Pallas kernel routed through shard_map: per-shard shapes and
     the pallas_call must survive tracing/lowering (full interpret-mode
