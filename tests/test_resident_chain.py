@@ -22,6 +22,7 @@ from coreth_tpu.crypto.secp256k1 import priv_to_address
 from coreth_tpu.ethdb import MemoryDB
 from coreth_tpu.native.mpt import load_inc
 from coreth_tpu.state.database import Database
+from coreth_tpu.state.statedb import StateDB
 from coreth_tpu.trie.triedb import TrieDatabase
 
 pytestmark = pytest.mark.skipif(
@@ -470,6 +471,43 @@ class TestResidentReorgFuzz:
                 assert s_r.get_nonce(addr) == s_d.get_nonce(addr), rnd
         resident.stop()
         default.stop()
+
+
+class TestResidentPruner:
+    def test_offline_prune_then_reopen_resident(self):
+        """The resident path's interval exports write content-addressed
+        nodes straight to disk (including abandoned side-branch nodes);
+        the offline mark-sweep pruner must keep the live image intact
+        and a reopened resident chain must boot and extend over it."""
+        from coreth_tpu.core.pruner import Pruner
+
+        diskdb = MemoryDB()
+        chain = make_chain(diskdb=diskdb, commit_interval=2)
+        counts = {}
+        blocks = build_blocks(chain, 4, tx_gen(counts))
+        for b in blocks:
+            chain.insert_block(b)
+            chain.accept(b)
+        chain.drain_acceptor_queue()
+        chain.stop()  # shutdown export: tip image on disk
+
+        tip = blocks[-1]
+        pruner = Pruner(diskdb, TrieDatabase(diskdb))
+        pruner.prune(tip.root, chain.genesis_block.root)
+        # tip state fully readable from the pruned disk
+        st = StateDB(tip.root, Database(TrieDatabase(diskdb)))
+        assert st.get_balance(ADDR2) == FUND + 1000 + 1001 + 1002 + 1003
+
+        reopened = make_chain(diskdb=diskdb, commit_interval=2)
+        assert reopened.last_accepted.hash() == tip.hash()
+        more = build_blocks(reopened, 2, tx_gen(counts))
+        for b in more:
+            reopened.insert_block(b)
+            reopened.accept(b)
+        reopened.drain_acceptor_queue()
+        assert reopened.acceptor_error is None
+        assert reopened.state().get_nonce(ADDR1) == 6
+        reopened.stop()
 
 
 class TestResidentVM:
