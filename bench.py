@@ -297,7 +297,7 @@ def main():
     global _ACTIVE_WATCHDOG
     wd = PhaseWatchdog(deadline)
     _ACTIVE_WATCHDOG = wd
-    wd.arm("backend-init", 240)
+    wd.arm("backend-init", 300)
     from coreth_tpu.utils import enable_compilation_cache
 
     enable_compilation_cache()
@@ -316,10 +316,24 @@ def main():
     else:
         planned = PlannedCommit()
 
+    # micro decomposition FIRST (VERDICT r4 #2): link bandwidth, dispatch
+    # round-trip, and kernel-only throughput land before any leg — a
+    # wedge mid-leg still leaves the gap attributable to link vs
+    # dispatch vs kernel.
+    try:
+        measure_micro(wd, kernel)
+    except Exception as e:  # noqa: BLE001 — micro is diagnostic only
+        REPORT["micro_error"] = f"{type(e).__name__}: {e}"
+
     def run_device(name):
         keys, vals, off = workloads[name]["arrays"]
         p = plan_commit(keys, vals, off)
-        return p.execute_planned(planned)
+        root = p.execute_planned(planned)
+        workloads[name]["h2d_bytes"] = planned.last_h2d_bytes
+        workloads[name]["dispatches"] = planned.last_dispatches
+        workloads[name]["transfers"] = planned.last_transfers
+        workloads[name]["segments"] = len(p.export_words()[0])
+        return root
 
     # small leg: compile + land a device number before the big attempt
     wd.arm("small-warmup", 480)
@@ -330,6 +344,14 @@ def main():
     assert root == workloads["small"]["cpu_root"]
     small = workloads["small"]
     REPORT["small_tpu_nodes_per_sec"] = round(small["nodes"] / small_s, 1)
+    REPORT["small_dispatches"] = small["dispatches"]
+    REPORT["small_transfers"] = small["transfers"]
+    REPORT["small_segments"] = small["segments"]
+    REPORT["small_h2d_mb"] = round(small["h2d_bytes"] / 1e6, 2)
+    if REPORT.get("h2d_mb_per_sec"):
+        # how much of the measured wall is pure link time at measured BW
+        REPORT["small_link_s_at_measured_bw"] = round(
+            small["h2d_bytes"] / 1e6 / REPORT["h2d_mb_per_sec"], 3)
     REPORT["value"] = REPORT["small_tpu_nodes_per_sec"]
     REPORT["vs_baseline"] = round(small["cpu_s"] / small_s, 3)
     REPORT["scope"] = "small"
@@ -348,6 +370,13 @@ def main():
     big_s, root = best_of(lambda: run_device("big"), repeats)
     assert root == big["cpu_root"]
     REPORT["big_tpu_nodes_per_sec"] = round(big["nodes"] / big_s, 1)
+    REPORT["big_dispatches"] = big["dispatches"]
+    REPORT["big_transfers"] = big["transfers"]
+    REPORT["big_segments"] = big["segments"]
+    REPORT["big_h2d_mb"] = round(big["h2d_bytes"] / 1e6, 2)
+    if REPORT.get("h2d_mb_per_sec"):
+        REPORT["big_link_s_at_measured_bw"] = round(
+            big["h2d_bytes"] / 1e6 / REPORT["h2d_mb_per_sec"], 3)
     REPORT["value"] = REPORT["big_tpu_nodes_per_sec"]
     REPORT["vs_baseline"] = round(big["cpu_s"] / big_s, 3)
     REPORT["scope"] = "big"
@@ -387,6 +416,82 @@ def main():
     wd.cancel()
     REPORT["total_s"] = round(time.monotonic() - t_start, 1)
     emit()
+
+
+def measure_micro(wd, kernel):
+    """Link/dispatch/kernel decomposition (VERDICT r4 #2). Each number is
+    independent of the commit legs, so even a 60-second ALIVE window
+    yields attribution:
+
+      device_roundtrip_ms    dispatch+sync floor (tiny jitted op, d2h)
+      h2d_mb_per_sec         achieved host->device bandwidth (32 MiB put)
+      d2h_mb_per_sec         achieved device->host bandwidth
+      kernel_hashes_per_sec  keccak-f[1600] permutations/s with transfers
+                             excluded (device-resident input, 16 queued
+                             dispatches, one sync)
+      kernel_mb_per_sec      same, as absorbed padded-message bytes
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    # dispatch round-trip floor
+    wd.arm("micro-roundtrip", 120)
+    tiny = jax.device_put(np.zeros(8, np.uint32))
+    bump = jax.jit(lambda x: x + 1)
+    np.asarray(bump(tiny))  # compile
+    rt, _ = best_of(lambda: (np.asarray(bump(tiny)), 0)[1], 5)
+    REPORT["device_roundtrip_ms"] = round(rt * 1e3, 2)
+
+    # link bandwidth, both directions (32 MiB payload)
+    wd.arm("micro-link", 180)
+    buf = np.random.default_rng(0).integers(
+        0, 2**32, size=(8 << 20,), dtype=np.uint32)  # 32 MiB
+    jax.device_put(buf).block_until_ready()  # first put may init pools
+    t, _ = best_of(
+        lambda: (jax.device_put(buf).block_until_ready(), 0)[1], 3)
+    REPORT["h2d_mb_per_sec"] = round(buf.nbytes / 1e6 / t, 1)
+    # fresh device array per repeat: jax.Array caches its host copy
+    # after the first np.asarray, which would turn repeats 2..n into
+    # memcpy-speed cache hits and corrupt the link attribution
+    best = float("inf")
+    for _ in range(3):
+        dev = jax.device_put(buf)
+        dev.block_until_ready()
+        t0 = time.perf_counter()
+        np.asarray(dev)
+        best = min(best, time.perf_counter() - t0)
+        del dev
+    REPORT["d2h_mb_per_sec"] = round(buf.nbytes / 1e6 / best, 1)
+
+    # kernel-only keccak throughput: device-resident input, transfers
+    # excluded; 16 dispatches queued, one synchronization
+    wd.arm("micro-kernel", 420)
+    if kernel == "pallas":
+        from coreth_tpu.ops.keccak_pallas import staged_seg_impl
+
+        seg = staged_seg_impl()
+    else:
+        from coreth_tpu.ops.keccak_staged import _segment_keccak
+
+        seg = _segment_keccak
+    lanes = int(os.environ.get("CORETH_TPU_BENCH_KERNEL_LANES", "8192"))
+    words = jax.device_put(np.random.default_rng(1).integers(
+        0, 2**32, size=(lanes, 1, 34), dtype=np.uint32))
+    f = jax.jit(seg)
+    f(words).block_until_ready()  # compile
+    reps = 16
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        outs = [f(words) for _ in range(reps)]
+        jax.block_until_ready(outs)
+        best = min(best, time.perf_counter() - t0)
+    hashes = lanes * reps / best
+    REPORT["kernel_lanes"] = lanes
+    REPORT["kernel_hashes_per_sec"] = round(hashes, 1)
+    REPORT["kernel_mb_per_sec"] = round(hashes * 136 / 1e6, 1)
 
 
 def run_resident(wd, planned_kernel="xla"):
@@ -469,6 +574,8 @@ def run_resident(wd, planned_kernel="xla"):
             f"pipelined resident root mismatch (round {rnd})"
 
     out["res_dirty_nodes"] = dirty_total
+    out["res_dispatches_per_commit"] = ex.last_dispatches
+    out["res_transfers_per_commit"] = ex.last_transfers
     out["res_h2d_bytes_per_node"] = round(h2d_total / max(dirty_total, 1), 1)
     out["res_h2d_mb_per_commit"] = round(h2d_total / rounds / 1e6, 2)
     out["res_cpu_nodes_per_sec"] = round(dirty_total / cpu_t, 1)

@@ -139,8 +139,35 @@ class ResidentTrieWriter(TrieWriter):
         self.commit_interval = commit_interval
         self.memory_cap = memory_cap
         self._last_accepted = None
+        self._capped = None  # detached-mode delegate, created on demand
+        # block ids whose roots the capped delegate referenced on insert
+        # and has not yet balanced with an accept/reject — the ONLY
+        # reliable detached-block marker (mirror.reject is silent for
+        # blocks it never saw, so MirrorError can't key the delegation)
+        self._capped_inflight: set = set()
+
+    # After a disk fallback the mirror never re-registers roots, so every
+    # later block runs the default forest path. Delegating its lifecycle
+    # to a CappedMemoryTrieWriter keeps the <= commit_interval recovery
+    # guarantee alive while detached: interval db.commit + tip buffer +
+    # shutdown commit, exactly the pruning policy the chain would have
+    # booted with if resident mode were off.
+    @property
+    def _detached(self) -> bool:
+        return getattr(self.mirror, "detached", False)
+
+    def _capped_writer(self) -> "CappedMemoryTrieWriter":
+        if self._capped is None:
+            self._capped = CappedMemoryTrieWriter(
+                self.db, commit_interval=self.commit_interval,
+                memory_cap=self.memory_cap)
+        return self._capped
 
     def insert_trie(self, block) -> None:
+        if self._detached:
+            self._capped_writer().insert_trie(block)
+            self._capped_inflight.add(block.hash())
+            return
         # account nodes never enter the forest; storage nodes ride the
         # memory cap below. Nothing to pin: the mirror's applied stack is
         # the reference's "root reference" for in-flight blocks.
@@ -153,14 +180,19 @@ class ResidentTrieWriter(TrieWriter):
         try:
             self.mirror.accept(block.hash())
         except MirrorError as e:
-            # blocks the mirror never saw: boot-recovery replays through
-            # the default path (benign), or the mirror detached after a
-            # disk fallback (state/resident/fallbacks counter + warn in
-            # resident_trie.py) — count it so a stuck export shows up
             from ..log import get_logger
             from ..metrics import default_registry
 
             default_registry.counter("state/resident/accept_misses").inc(1)
+            if block.hash() in self._capped_inflight:
+                # post-fallback block: its account nodes live in the
+                # forest, so the capped policy (interval commit + tip
+                # buffer) carries durability from here
+                self._capped_inflight.discard(block.hash())
+                self._capped_writer().accept_trie(block)
+                return
+            # blocks the mirror never saw and no detach: boot-recovery
+            # replays through the default path (benign)
             get_logger("state").warning(
                 "resident accept miss for block %d (%s) — interval export "
                 "skipped", block.number, e)
@@ -172,10 +204,19 @@ class ResidentTrieWriter(TrieWriter):
     def reject_trie(self, block) -> None:
         from ..trie.resident_mirror import MirrorError
 
+        if block.hash() in self._capped_inflight:
+            # post-detach block: referenced by the capped delegate's
+            # insert_trie; balance it (blockchain.go:1361-1365
+            # discipline). The mirror never saw it — do NOT touch the
+            # mirror, whose reject() only raises for ACCEPTED blocks and
+            # is silent for unknown ones, so an exception can't key this.
+            self._capped_inflight.discard(block.hash())
+            self._capped_writer().reject_trie(block)
+            return
         try:
             self.mirror.reject(block.hash())
         except MirrorError:
-            pass
+            pass  # duplicate/out-of-order reject of an accepted block
 
     def _export(self, block) -> None:
         from ..trie.resident_mirror import MirrorError
@@ -198,6 +239,11 @@ class ResidentTrieWriter(TrieWriter):
     def shutdown(self) -> None:
         if self._last_accepted is not None:
             self._export(self._last_accepted)
+        if self._capped is not None:
+            # detached tail: commit the newest forest root so restart
+            # recovers from <= commit_interval back of the true head,
+            # not of the last mirror-accepted block
+            self._capped.shutdown()
 
 
 class _BoundedBuffer:

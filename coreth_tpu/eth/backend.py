@@ -195,6 +195,33 @@ class EthBackend:
 
     # --- merkle proofs (internal/ethapi/api.go:669 GetProof) -------------
 
+    def walkable_state_trie(self, root: bytes):
+        """A state trie at [root] with real Python nodes to walk
+        (proofs, dumps, leaf iteration). Resident roots have none, so
+        flush the changed account nodes to disk first (O(delta) export)
+        and open the hashdb image like any historical root."""
+        state_trie = self.chain.state_database.open_trie(root)
+        if not getattr(state_trie, "resident", False):
+            return state_trie
+        from ..trie.resident_mirror import MirrorError
+
+        mirror = self.chain.state_database.mirror
+        try:
+            key = mirror.key_for_root(root)
+            if key is None:  # pruned between open_trie and here
+                raise MirrorError("root left the resident window")
+            # children-first like ResidentTrieWriter._export: flush
+            # storage-trie nodes BEFORE the account batch that makes
+            # has_state(root) true, else a crash right after this
+            # call boots a root with missing storage subtrees (the
+            # exact ordering _export's comment forbids)
+            triedb = self.chain.state_database.triedb
+            mirror.export_to(self.chain.diskdb, at_block=key,
+                             pre_write=lambda: triedb.cap(0))
+        except MirrorError as e:
+            raise RPCError(-32000, f"state unavailable: {e}")
+        return self.chain.state_database.triedb.open_state_trie(root)
+
     def get_proof(self, addr: bytes, storage_keys, tag: str) -> dict:
         from ..native import keccak256
         from ..state.account import Account
@@ -203,23 +230,7 @@ class EthBackend:
         blk = self.block_by_tag(tag)
         if blk is None:
             raise RPCError(-32000, "block not found")
-        state_trie = self.chain.state_database.open_trie(blk.root)
-        if getattr(state_trie, "resident", False):
-            # resident roots have no Python node objects to walk: flush
-            # the changed account nodes to disk (O(delta) export) and
-            # prove from the hashdb image like any historical root
-            from ..trie.resident_mirror import MirrorError
-
-            mirror = self.chain.state_database.mirror
-            try:
-                key = mirror.key_for_root(blk.root)
-                if key is None:  # pruned between open_trie and here
-                    raise MirrorError("root left the resident window")
-                mirror.export_to(self.chain.diskdb, at_block=key)
-            except MirrorError as e:
-                raise RPCError(-32000, f"state unavailable: {e}")
-            state_trie = self.chain.state_database.triedb.open_state_trie(
-                blk.root)
+        state_trie = self.walkable_state_trie(blk.root)
         account_proof = prove(state_trie.trie, keccak256(addr))
         blob = state_trie.get(addr)
         acct = Account.decode(blob) if blob else Account()

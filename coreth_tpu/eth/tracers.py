@@ -16,6 +16,7 @@ from ..core.types import Signer
 from ..evm.evm import EVM, Config, TxContext
 from ..rpc.server import RPCError
 from .api import hb, hx, parse_bytes
+from .tracer_dsl import DSLTracer
 
 
 class StructLogger:
@@ -217,13 +218,14 @@ class DebugAPI:
         for i, tx in enumerate(blk.transactions):
             traced = upto_index is None or i == upto_index
             tracer = tracer_factory() if traced else None
-            cfg = Config(tracer=tracer if isinstance(tracer, StructLogger) else None)
+            cfg = Config(tracer=tracer if isinstance(
+                tracer, (StructLogger, DSLTracer)) else None)
             block_ctx = new_block_context(blk.header, chain)
             tx_state = state
             if isinstance(tracer, PrestateTracer):
                 tx_state = tracer.wrap(state)
             evm = EVM(block_ctx, TxContext(), tx_state, self.b.chain_config, cfg)
-            if isinstance(tracer, (CallTracer, FourByteTracer)):
+            if isinstance(tracer, (CallTracer, FourByteTracer, DSLTracer)):
                 evm = _instrument_call_tracer(evm, tracer)
             state.set_tx_context(tx.hash(), i)
             used = [0]
@@ -244,13 +246,14 @@ class DebugAPI:
                    tracer_factory):
         """Trace tx [i] from its captured pre-state (runs on a worker)."""
         tracer = tracer_factory()
-        cfg = Config(tracer=tracer if isinstance(tracer, StructLogger) else None)
+        cfg = Config(tracer=tracer if isinstance(
+                tracer, (StructLogger, DSLTracer)) else None)
         block_ctx = new_block_context(blk.header, chain)
         tx_state = pre_state
         if isinstance(tracer, PrestateTracer):
             tx_state = tracer.wrap(pre_state)
         evm = EVM(block_ctx, TxContext(), tx_state, self.b.chain_config, cfg)
-        if isinstance(tracer, (CallTracer, FourByteTracer)):
+        if isinstance(tracer, (CallTracer, FourByteTracer, DSLTracer)):
             evm = _instrument_call_tracer(evm, tracer)
         pre_state.set_tx_context(tx.hash(), i)
         used = [0]
@@ -330,6 +333,42 @@ class DebugAPI:
             for tx, tracer, _ in results
         ]
 
+    # --- state dumps (core/state/dump.go:139 via eth/api.go DumpBlock /
+    # AccountRange) --------------------------------------------------------
+
+    def dumpBlock(self, tag: str, opts: dict = None) -> dict:
+        """debug_dumpBlock: every account at the block's root. opts:
+        {"includeStorage": bool, "includeCode": bool, "maxResults": int,
+        "start": hexkey} — paged via the returned "next" key."""
+        opts = opts or {}
+        blk = self.b.block_by_tag(tag)
+        if blk is None:
+            raise RPCError(-32000, "block not found")
+        from ..core.rawdb import read_code
+        from ..state.dump import dump_accounts
+
+        state_trie = self.b.walkable_state_trie(blk.root)
+        start = opts.get("start")
+        out = dump_accounts(
+            state_trie,
+            start=parse_bytes(start) if start else None,
+            max_results=int(opts.get("maxResults", 0) or 0),
+            include_storage=bool(opts.get("includeStorage", False)),
+            include_code=bool(opts.get("includeCode", False)),
+            storage_trie_opener=self.b.chain.state_database.open_storage_trie,
+            code_getter=lambda h: read_code(self.b.chain.diskdb, h),
+        )
+        out["root"] = hb(blk.root)
+        return out
+
+    def accountRange(self, tag: str, start: str = None,
+                     max_results: int = 256) -> dict:
+        """debug_accountRange: the paged iterator dump (IteratorDump)."""
+        return self.dumpBlock(tag, {
+            "start": start,
+            "maxResults": max(1, int(max_results)),
+        })
+
     def _tracer_factory(self, config: dict):
         name = config.get("tracer")
         if name == "callTracer":
@@ -338,6 +377,16 @@ class DebugAPI:
             return FourByteTracer
         if name == "prestateTracer":
             return PrestateTracer
+        if name and "def " in name:
+            # operator-supplied tracer SCRIPT (the goja.go:1 capability,
+            # sandboxed: own AST interpreter, no eval — eth/tracer_dsl.py)
+            from .tracer_dsl import DSLError
+
+            try:
+                DSLTracer(name)  # validate once, fail at registration
+            except DSLError as e:
+                raise RPCError(-32000, f"bad tracer script: {e}")
+            return lambda: DSLTracer(name)
         if name:
             raise RPCError(-32000, f"unknown tracer {name!r}")
         return lambda: StructLogger(

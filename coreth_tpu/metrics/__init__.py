@@ -198,6 +198,13 @@ def get_or_register_timer(name: str, registry: Optional[Registry] = None) -> Tim
     return (registry or default_registry).timer(name)
 
 
+def count_drop(name: str, registry: Optional[Registry] = None) -> None:
+    """Increment a drop/swallowed-exception counter (coreth's gossip and
+    handler stats pattern): the ONE helper every silenced except-path
+    uses, so the drop namespace stays in one place."""
+    (registry or default_registry).counter(name).inc(1)
+
+
 def get_or_register_meter(name: str, registry: Optional[Registry] = None) -> Meter:
     return (registry or default_registry).meter(name)
 

@@ -16,6 +16,12 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 
+def _count(name: str) -> None:
+    from ..metrics import count_drop
+
+    count_drop(name)
+
+
 class NetworkError(Exception):
     pass
 
@@ -130,7 +136,7 @@ class Network:
             try:
                 h(node_id, request)
             except Exception:
-                pass
+                _count("peer/drops/failed_handler_error")
 
     # --- cross-chain (network.go:199-328) ---------------------------------
 
@@ -208,12 +214,12 @@ class Network:
                     try:
                         on_failed(node_id)
                     except Exception:
-                        pass
+                        _count("peer/drops/failed_callback_error")
                 return
             try:
                 on_response(node_id, resp)
             except Exception:
-                pass
+                _count("peer/drops/response_callback_error")
 
         return self._executor().submit(run)
 
@@ -222,7 +228,7 @@ class Network:
             try:
                 transport(self.self_id, b"\xff" + payload)  # gossip marker
             except Exception:
-                pass
+                _count("peer/drops/gossip_send_failure")
 
     # --- inbound ----------------------------------------------------------
 
@@ -230,7 +236,12 @@ class Network:
         """Entry point peers call (wire this as their transport)."""
         if request[:1] == b"\xff":
             for h in self._gossip_handlers:
-                h(sender, request[1:])
+                try:
+                    h(sender, request[1:])
+                except Exception:
+                    # one bad handler must not starve the rest, but the
+                    # drop is counted, never silent
+                    _count("peer/drops/gossip_handler_error")
             return b""
         if self._request_handler is None:
             raise NetworkError("no request handler registered")

@@ -27,6 +27,14 @@ KIND_GOSSIP = 2
 _MAX_FRAME = 32 * 1024 * 1024
 
 
+def _drop(reason: str) -> None:
+    """Inbound-path drop counter (coreth keeps per-handler gossip/request
+    stats; a bare swallow would make a misbehaving peer invisible)."""
+    from ..metrics import count_drop
+
+    count_drop(f"peer/drops/{reason}")
+
+
 class TransportError(Exception):
     pass
 
@@ -104,15 +112,17 @@ class TransportServer:
                         try:
                             self.gossip_handler(sender, payload)
                         except Exception:
-                            pass
+                            _drop("gossip_handler_error")
                     continue
                 if kind != KIND_REQUEST:
+                    _drop("unknown_frame_kind")
                     continue
 
                 def work(rid=req_id, data=payload):
                     try:
                         resp = self.handler(sender, data)
                     except Exception:
+                        _drop("request_handler_error")
                         resp = b""
                     try:
                         _write_frame(conn, wlock, KIND_RESPONSE, rid, resp)

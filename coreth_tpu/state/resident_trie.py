@@ -137,6 +137,12 @@ class MirrorStateTrie:
             get_logger("state").warning(
                 "resident account trie falling back to the disk path "
                 "(%s) — resident mode detaches until restart", e)
+            # the flag ResidentTrieWriter keys its detached-mode interval
+            # commits on (state_manager.py): without it, accept-side
+            # interval exports silently stop while blocks keep landing in
+            # the forest, and the <= commit_interval recovery guarantee
+            # dies with them
+            self.mirror.detached = True
             t = self._disk_apply()
             root, nodeset = t.commit(collect_leaf=True)
             return root, nodeset

@@ -179,6 +179,11 @@ class ResidentAccountMirror:
                     continue
                 self._forget(k)
                 extra -= 1
+            # descendants of a collected record have dangling parents and
+            # can never replay — collect them now (matching reject()'s
+            # cleanup) instead of surfacing later as a "no path" error in
+            # _switch_to
+            self._prune_orphans()
 
     def _promote_anon(self) -> bytes:
         """Name the anonymous head by its ROOT so new work can build on

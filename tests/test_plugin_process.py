@@ -1,0 +1,138 @@
+"""Out-of-process VM boundary (VERDICT r4 #5; reference
+/root/reference/plugin/main.go:33 rpcchainvm.Serve): the VM runs in a
+CHILD PROCESS serving its snowman interface over a unix socket; this
+process plays the consensus engine. The flagship scenario is the
+cross-process variant of the two-VM state-sync harness
+(syncervm_test.go:269): a fresh client VM bootstraps the remote
+process's committed state without executing its blocks, then ingests a
+freshly built remote block — proving the whole interface (blocks,
+summaries, leaf/code/block requests with range proofs) survives
+serialization."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from coreth_tpu import params
+from coreth_tpu.core.genesis import Genesis, GenesisAccount
+from coreth_tpu.core.types import Signer, Transaction
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.peer.network import Network
+from coreth_tpu.plugin import RemoteVM
+from coreth_tpu.sync.client import SyncClient
+from coreth_tpu.vm.shared_memory import Memory
+from coreth_tpu.vm.syncervm import StateSyncClient
+from coreth_tpu.vm.vm import SnowContext, VM, VMConfig
+
+from test_sync import ADDR, DEST, FUND, KEY
+
+N_BLOCKS = 8
+
+
+@pytest.fixture()
+def remote_vm(tmp_path):
+    sock = str(tmp_path / "vm.sock")
+    child = subprocess.Popen(
+        [sys.executable, os.path.join(os.path.dirname(__file__),
+                                      "plugin_child.py"), sock,
+         str(N_BLOCKS)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        deadline = time.monotonic() + 120
+        while not os.path.exists(sock):
+            if child.poll() is not None:
+                out, _ = child.communicate()
+                pytest.fail(f"plugin child died at boot:\n{out[-2000:]}")
+            if time.monotonic() > deadline:
+                pytest.fail("plugin child never opened its socket")
+            time.sleep(0.1)
+        remote = RemoteVM(sock, connect_timeout=30)
+        yield remote, child
+    finally:
+        if child.poll() is None:
+            try:
+                RemoteVM(sock, connect_timeout=2).shutdown()
+            except Exception:
+                child.kill()
+        child.wait(timeout=30)
+
+
+def fresh_client_vm():
+    vm = VM()
+    genesis = Genesis(
+        config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+        alloc={ADDR: GenesisAccount(balance=FUND)},
+    )
+    vm.initialize(SnowContext(shared_memory=Memory()), MemoryDB(), genesis,
+                  VMConfig())
+    return vm
+
+
+def test_state_sync_across_process_boundary(remote_vm):
+    remote, child = remote_vm
+    assert remote.health()
+    last = remote.last_accepted()
+    assert last.height == N_BLOCKS
+    assert remote.handshake() == last.id
+
+    summary = remote.get_last_state_summary()
+    assert summary is not None and summary.block_number == N_BLOCKS
+
+    # engine-side client VM syncs THROUGH the socket: the network
+    # transport is the remote process's appRequest endpoint
+    client_vm = fresh_client_vm()
+    net = Network(self_id=b"engine")
+    net.connect(b"plugin", remote.app_request)
+    StateSyncClient(client_vm, SyncClient(net)).accept_summary(summary)
+
+    assert client_vm.blockchain.last_accepted.hash() == summary.block_hash
+    st = client_vm.blockchain.state()
+    assert st.get_balance(DEST) == N_BLOCKS * 5 * 3
+    assert st.get_nonce(ADDR) == N_BLOCKS * 5
+
+    # post-sync handoff, still across the boundary: the remote VM builds
+    # a block from a tx issued over the socket; the engine drives
+    # verify/accept remotely; the synced client ingests the same bytes
+    signer = Signer(43112)
+    t = signer.sign(
+        Transaction(type=2, chain_id=43112, nonce=N_BLOCKS * 5,
+                    max_fee=10**12, max_priority_fee=10**9, gas=21000,
+                    to=DEST, value=9), KEY)
+    remote.issue_tx(t.encode())
+    blk = remote.build_block()
+    assert blk.height == N_BLOCKS + 1
+    remote.block_verify(blk.id)
+    remote.block_accept(blk.id)
+    assert remote.last_accepted().id == blk.id
+
+    vmb = client_vm.parse_block(blk.bytes)
+    assert vmb.id() == blk.id
+    vmb.verify()
+    vmb.accept()
+    client_vm.blockchain.drain_acceptor_queue()
+    assert client_vm.blockchain.last_accepted.hash() == blk.id
+    assert client_vm.blockchain.state().get_balance(DEST) == \
+        N_BLOCKS * 5 * 3 + 9
+
+    client_vm.shutdown()
+    remote.shutdown()
+    assert child.wait(timeout=30) == 0
+
+
+def test_remote_block_reject_and_errors(remote_vm):
+    remote, _child = remote_vm
+    # building with an empty mempool fails loudly across the boundary
+    from coreth_tpu.plugin import RemoteVMError
+
+    with pytest.raises(RemoteVMError):
+        remote.build_block()
+    # unknown block ids error instead of wedging the connection
+    with pytest.raises(RemoteVMError):
+        remote.block_verify(b"\x00" * 32)
+    # the connection survives errors: a real call still works
+    assert remote.last_accepted().height == N_BLOCKS
+    remote.shutdown()

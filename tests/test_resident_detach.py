@@ -1,0 +1,153 @@
+"""ResidentTrieWriter detached-mode lifecycle (ADVICE r4 medium + the
+r5 review fix): after a disk fallback sets mirror.detached, the writer
+must delegate post-detach blocks to a CappedMemoryTrieWriter — interval
+commits, balanced reference/dereference (core/blockchain.go:1361-1365
+discipline), and a shutdown commit — while pre-detach blocks still ride
+the mirror. mirror.reject is SILENT for unknown blocks and raises only
+for accepted ones (resident_mirror.py:288), so the delegation must key
+on the writer's own inflight set, never on MirrorError."""
+
+from coreth_tpu.core.state_manager import ResidentTrieWriter
+from coreth_tpu.trie.resident_mirror import MirrorError
+
+
+class StubBlock:
+    def __init__(self, number, root):
+        self.number = number
+        self.root = root
+        self._hash = b"B" + number.to_bytes(8, "big") + root[:23]
+
+    def hash(self):
+        return self._hash
+
+
+class StubMirror:
+    """Accepts only blocks it 'knows'; reject mirrors the real contract:
+    silent for unknown blocks, MirrorError for accepted ones."""
+
+    def __init__(self):
+        self.known = set()
+        self.accepted = set()
+        self.rejected = []
+        self.exports = []
+        self.detached = False
+
+    def accept(self, h):
+        if h not in self.known:
+            raise MirrorError("unknown block")
+        self.accepted.add(h)
+
+    def reject(self, h):
+        if h in self.accepted:
+            raise MirrorError("rejecting an ACCEPTED block")
+        self.rejected.append(h)
+
+    def export_to(self, diskdb, at_block=None, pre_write=None):
+        if pre_write is not None:
+            pre_write()
+        self.exports.append(at_block)
+
+
+class StubTrieDB:
+    def __init__(self):
+        self.refs = {}
+        self.commits = []
+        self.caps = []
+        self.dirty_size = 0
+        self.diskdb = object()
+
+    def reference(self, root):
+        self.refs[root] = self.refs.get(root, 0) + 1
+
+    def dereference(self, root):
+        self.refs[root] = self.refs.get(root, 0) - 1
+
+    def commit(self, root):
+        self.commits.append(root)
+
+    def cap(self, limit):
+        self.caps.append(limit)
+
+
+def make_writer(interval=4):
+    db = StubTrieDB()
+    mirror = StubMirror()
+    w = ResidentTrieWriter(db, mirror, commit_interval=interval)
+    return w, db, mirror
+
+
+def blk(n):
+    return StubBlock(n, bytes([n % 256]) * 32)
+
+
+def test_attached_blocks_ride_the_mirror():
+    w, db, mirror = make_writer()
+    b = blk(4)
+    mirror.known.add(b.hash())
+    w.insert_trie(b)
+    w.accept_trie(b)
+    assert b.hash() in mirror.accepted
+    assert mirror.exports == [b.hash()]  # interval boundary export
+    assert db.commits == []              # forest untouched while attached
+
+
+def test_detached_blocks_get_capped_policy():
+    w, db, mirror = make_writer(interval=2)
+    mirror.detached = True
+    accepted_roots = []
+    for n in range(1, 5):
+        b = blk(n)
+        w.insert_trie(b)
+        assert db.refs[b.root] == 1      # referenced like capped mode
+        w.accept_trie(b)
+        accepted_roots.append(b.root)
+    # interval commits at heights 2 and 4 keep <= commit_interval recovery
+    assert db.commits == [accepted_roots[1], accepted_roots[3]]
+    # mirror exports never fired for post-detach blocks
+    assert mirror.exports == []
+    w.shutdown()
+    # shutdown commits the newest forest root (capped delegate shutdown)
+    assert db.commits[-1] == accepted_roots[-1]
+
+
+def test_detached_reject_balances_reference():
+    w, db, mirror = make_writer()
+    mirror.detached = True
+    b = blk(7)
+    w.insert_trie(b)
+    assert db.refs[b.root] == 1
+    w.reject_trie(b)
+    assert db.refs[b.root] == 0          # balanced, no leak
+    assert mirror.rejected == []         # mirror never touched
+    # double reject is a no-op (inflight already cleared)
+    w.reject_trie(b)
+    assert db.refs[b.root] == 0
+
+
+def test_detached_duplicate_reject_of_accepted_block_is_noop():
+    # the regression the r5 review caught: a duplicate Reject of an
+    # ACCEPTED pre-detach block raises MirrorError; the writer must NOT
+    # interpret that as a capped-delegate block and dereference it
+    w, db, mirror = make_writer()
+    b = blk(3)
+    mirror.known.add(b.hash())
+    w.insert_trie(b)
+    w.accept_trie(b)
+    mirror.detached = True               # later fallback
+    w.reject_trie(b)                     # duplicate/out-of-order reject
+    assert db.refs.get(b.root, 0) == 0   # nothing dereferenced
+    assert db.commits == []              # and nothing committed
+
+
+def test_pre_detach_blocks_still_accept_through_mirror():
+    w, db, mirror = make_writer(interval=2)
+    early = blk(1)
+    mirror.known.add(early.hash())       # processed before the fallback
+    w.insert_trie(early)
+    mirror.detached = True               # fallback lands mid-flight
+    late = blk(2)
+    w.insert_trie(late)
+    w.accept_trie(early)                 # mirror path still works
+    assert early.hash() in mirror.accepted
+    w.accept_trie(late)                  # capped path for the new block
+    assert db.commits == [late.root]

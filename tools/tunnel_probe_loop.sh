@@ -1,18 +1,22 @@
 #!/bin/bash
 # Probe the axon TPU tunnel every 8 minutes; log liveness. On success:
-#   1. if BENCH_EARLY_r04.json is missing, land the early bench first
-#      (quick leg + Pallas parity — the round's minimum hardware evidence);
-#   2. if BENCH_FULL_r04.json is missing, run the FULL bench (big +
+#   1. if BENCH_EARLY_r05.json is missing, land the early bench first
+#      (small leg + micro link/dispatch/kernel decomposition — the
+#      round's minimum hardware evidence, VERDICT r4 #1+#2);
+#   2. if BENCH_FULL_r05.json is missing, run the FULL bench (big +
 #      resident + incremental legs) and land it.
+# Failed/partial attempts are preserved under tools/ so even a wedge
+# mid-leg leaves its decomposition data for PERF.md (r04 lost a whole
+# ALIVE window this way).
 # tools/BENCH_RUNNING exists while a bench is in flight so other jobs on
-# this 1-core container can avoid starving the device watchdogs (the
-# round-4 "wedge" during big-warmup was partly self-inflicted contention).
+# this 1-core container can avoid starving the device watchdogs.
 #
 # Probe discipline per memory/axon-tunnel-operations: PYTHONPATH must
 # include /root/.axon_site; generous timeout (120s >> healthy first-op
 # ~1.6-40s) so we never kill a merely-slow device-attached process.
 cd /root/repo
 LOG=tools/tunnel_probe.log
+ROUND=r05
 while true; do
   ts=$(date -u +%H:%M:%S)
   if timeout 120 env PYTHONPATH=/root/repo:/root/.axon_site python -c "
@@ -20,7 +24,7 @@ import jax, jax.numpy as jnp
 (jnp.zeros(8)+1).block_until_ready()
 " >/dev/null 2>&1; then
     echo "$ts ALIVE" >> "$LOG"
-    if [ ! -f BENCH_EARLY_r04.json ]; then
+    if [ ! -f BENCH_EARLY_${ROUND}.json ]; then
       echo "$ts running early bench" >> "$LOG"
       touch tools/BENCH_RUNNING
       timeout 900 env PYTHONPATH=/root/repo:/root/.axon_site \
@@ -29,14 +33,15 @@ import jax, jax.numpy as jnp
       # land only a clean early report (device number present, no
       # watchdog error) — a partial must NOT suppress the retry
       if [ $rc -eq 0 ] && grep -q '"scope": "small"' /tmp/bench_early_probe.json \
-         && ! grep -q '"error"' /tmp/bench_early_probe.json; then
-        cp /tmp/bench_early_probe.json BENCH_EARLY_r04.json
+         && ! grep -q '"error":' /tmp/bench_early_probe.json; then
+        cp /tmp/bench_early_probe.json BENCH_EARLY_${ROUND}.json
         echo "$ts early bench done" >> "$LOG"
       else
-        echo "$ts early bench partial/failed (rc=$rc)" >> "$LOG"
+        cp /tmp/bench_early_probe.json "tools/bench_early_partial_${ts//:/}.json" 2>/dev/null
+        echo "$ts early bench partial/failed (rc=$rc; partial saved)" >> "$LOG"
       fi
       rm -f tools/BENCH_RUNNING
-    elif [ ! -f BENCH_FULL_r04.json ]; then
+    elif [ ! -f BENCH_FULL_${ROUND}.json ]; then
       echo "$ts running FULL bench" >> "$LOG"
       touch tools/BENCH_RUNNING
       timeout 1800 env PYTHONPATH=/root/repo:/root/.axon_site \
@@ -47,11 +52,12 @@ import jax, jax.numpy as jnp
       # artifact and should retry next ALIVE window
       if [ $rc -eq 0 ] \
          && grep -q '"scope": "\(big\|resident\|incremental\)' /tmp/bench_full_probe.json \
-         && ! grep -q '"res_error"\|"inc_error"\|"error"' /tmp/bench_full_probe.json; then
-        cp /tmp/bench_full_probe.json BENCH_FULL_r04.json
+         && ! grep -q '"res_error"\|"inc_error"\|"error":' /tmp/bench_full_probe.json; then
+        cp /tmp/bench_full_probe.json BENCH_FULL_${ROUND}.json
         echo "$ts FULL bench done" >> "$LOG"
       else
-        echo "$ts FULL bench partial/failed (rc=$rc)" >> "$LOG"
+        cp /tmp/bench_full_probe.json "tools/bench_full_partial_${ts//:/}.json" 2>/dev/null
+        echo "$ts FULL bench partial/failed (rc=$rc; partial saved)" >> "$LOG"
       fi
       rm -f tools/BENCH_RUNNING
     fi
