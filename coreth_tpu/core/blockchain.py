@@ -51,7 +51,15 @@ class CacheConfig:
     # nodes flush to disk at commit_interval. Requires the native
     # incremental planner AND pruning=True (interval persistence is a
     # pruning policy); silently falls back when either is absent.
-    resident_account_trie: bool = False
+    # "auto" (the default): ON exactly when a real TPU backend resolves —
+    # the TPU-native design is the production default on TPU hardware,
+    # while CPU-only environments keep the default trie path.
+    resident_account_trie: "bool | str" = "auto"
+    # watchdog budget (seconds) for one resident device commit/readback;
+    # on expiry the mirror takes over on the host (full rehash + CPU
+    # commits — trie/resident_mirror.py _take_over_host) and the chain
+    # continues without stalling. None disables the watchdog.
+    resident_commit_timeout: "float | None" = None
     # bloom-bit index section (bloom_indexer.go BloomBitsBlocks)
     bloom_section_size: int = 4096
 
@@ -185,7 +193,22 @@ class BlockChain:
             from ..native.mpt import load_inc
 
             if load_inc() is not None:
-                self._boot_mirror()
+                resident = cache_config.resident_account_trie
+                if resident == "auto":
+                    # production default: resident exactly when a TPU
+                    # backend resolves (the planned kernel selection's
+                    # probe). Fail-soft like every other "auto" device
+                    # knob (ops/device.py): no jax -> default path. The
+                    # probe runs only inside the pruning+planner gates,
+                    # so archival/no-native boots never import jax here.
+                    try:
+                        from ..ops.keccak_planned import _tpu_backend
+
+                        resident = _tpu_backend()
+                    except Exception:
+                        resident = False
+                if resident:
+                    self._boot_mirror()
 
         # flat snapshot tree over the last-accepted state (snapshot_limit
         # gates it, like CacheConfig.SnapshotLimit in the reference)
@@ -348,6 +371,7 @@ class BlockChain:
         self.mirror = ResidentAccountMirror(
             list(iterate_leaves(tr)),
             base_key=self.last_accepted.hash(),
+            device_timeout=self.cache_config.resident_commit_timeout,
         )
         self.state_database.mirror = self.mirror
         self.trie_writer = ResidentTrieWriter(

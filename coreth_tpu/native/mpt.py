@@ -346,6 +346,8 @@ def load_inc():
         ]
         lib.mpt_inc_res_mark_clean.restype = None
         lib.mpt_inc_res_mark_clean.argtypes = [ctypes.c_void_p]
+        lib.mpt_inc_mark_all_dirty.restype = None
+        lib.mpt_inc_mark_all_dirty.argtypes = [ctypes.c_void_p]
         lib.mpt_inc_checkpoint.restype = None
         lib.mpt_inc_checkpoint.argtypes = [ctypes.c_void_p]
         lib.mpt_inc_discard_checkpoint.restype = None
@@ -386,6 +388,37 @@ def load_inc():
         lib.mpt_inc_free.argtypes = [ctypes.c_void_p]
         _inc_lib = lib
         return _inc_lib
+
+
+class DeviceWedgedError(RuntimeError):
+    """The device backend did not answer within the watchdog budget —
+    the axon-tunnel failure mode where even a tiny sync hangs forever.
+    Callers take over on the host (IncrementalTrie.rehash_host)."""
+
+
+def _run_with_watchdog(fn, timeout: float, what: str):
+    """Run fn() on a daemon worker; DeviceWedgedError on timeout. The
+    abandoned worker may finish later — callers must ensure fn touches
+    only device/executor state, never shared host structures."""
+    box: dict = {}
+    done = threading.Event()
+
+    def work():
+        try:
+            box["val"] = fn()
+        except BaseException as e:  # noqa: BLE001 — crosses threads
+            box["err"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=work, daemon=True, name=f"wd-{what}")
+    t.start()
+    if not done.wait(timeout):
+        raise DeviceWedgedError(
+            f"{what} produced nothing within {timeout:.0f}s")
+    if "err" in box:
+        raise box["err"]
+    return box["val"]
 
 
 EMPTY_ROOT = bytes.fromhex(
@@ -572,6 +605,49 @@ class IncrementalTrie:
             "num_dirty": int(meta[4]),
             "fresh_bytes": int(meta[5]),
         }
+
+    def commit_resident_timed(self, executor, timeout: Optional[float]):
+        """commit_resident + synchronized root under a device watchdog.
+
+        Raises DeviceWedgedError if the device does not produce the root
+        within [timeout] seconds. The watchdog thread runs ONLY the
+        executor/device half (run + sync); every native-trie mutation —
+        the plan export before, res_mark_clean after — stays on the
+        calling thread, so an abandoned worker that later revives can
+        never race a host takeover's rehash on this trie's memory.
+
+        timeout=None degrades to the plain synchronized commit."""
+        if self.num_nodes == 0:
+            # empty-path: host constant, no device op to guard
+            return executor.root_bytes(self.commit_resident(executor))
+        self._check_mode("resident")
+        executor.check_binding(self)
+        export = self.export_resident_plan()
+        self._pin_mode("resident")
+        executor.bind(self)
+        if export is None:
+            work = lambda: executor.root_bytes(executor.last_root)  # noqa: E731
+        else:
+            def work():
+                return executor.root_bytes(executor.run(export))
+        if timeout is None:
+            root = work()
+        else:
+            root = _run_with_watchdog(work, timeout, "resident commit")
+        if export is not None:
+            self._lib.mpt_inc_res_mark_clean(self._h)
+        return root
+
+    def rehash_host(self, threads: int = 1) -> bytes:
+        """Device-failure takeover: rebuild the FULL host digest cache
+        with one CPU commit and re-pin the trie to host mode. After a
+        resident commit history the host cache is stale (digests lived
+        in the device store); marking every node dirty makes the next
+        host plan a whole-trie rehash, after which commit_cpu /
+        export_nodes serve the trie with no device at all."""
+        self._lib.mpt_inc_mark_all_dirty(self._h)
+        self._mode = "host"
+        return self.commit_cpu(threads=threads)
 
     def commit_resident(self, executor):
         """Device-resident commit: plan, ship fresh rows + patch tables,

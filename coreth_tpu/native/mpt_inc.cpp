@@ -1208,6 +1208,21 @@ void mpt_inc_res_tables(void* h, int32_t* rowidx, int32_t* lane_slot,
 // Digests deliberately do NOT return to the host (deferred absorb).
 void mpt_inc_res_mark_clean(void* h) { res_mark_clean(*(Inc*)h); }
 
+// Device-failure takeover seam: mark EVERY node dirty so the next host
+// plan re-hashes the whole trie. After a resident (device-store) commit
+// history the host digest cache is stale; a full host rehash
+// (mark_all_dirty + plan + execute_cpu) re-establishes it so the trie
+// can continue in host commit mode with the device gone — the mirror's
+// transparent CPU takeover (trie/resident_mirror.py) rides this.
+void mpt_inc_mark_all_dirty(void* h) {
+  Inc* t = (Inc*)h;
+  walk_all(t->root, [](INode* n) {
+    n->dirty = true;
+    n->structural = true;
+    n->enc_len = -1;  // plan recomputes RLP lengths for dirty nodes
+  });
+}
+
 void mpt_inc_root(void* h, uint8_t* out32) {
   Inc* t = (Inc*)h;
   if (t->root)

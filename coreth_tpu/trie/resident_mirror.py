@@ -65,7 +65,8 @@ class ResidentAccountMirror:
     ANON = b"\x01" + b"anon" * 7 + b"\x01\x01\x01"
 
     def __init__(self, items: Sequence[Tuple[bytes, bytes]] = (),
-                 executor=None, base_key: Optional[bytes] = None):
+                 executor=None, base_key: Optional[bytes] = None,
+                 device_timeout: Optional[float] = None):
         if executor is None:
             from ..ops.keccak_resident import ResidentExecutor
 
@@ -73,11 +74,28 @@ class ResidentAccountMirror:
         self.ex = executor
         self._lock = threading.RLock()
         self.trie = IncrementalTrie(items)
+        # device-failure takeover (VERDICT r4 #4): a commit the device
+        # does not answer within [device_timeout] seconds triggers a
+        # one-way host takeover — full host rehash, then every later
+        # commit/export runs commit_cpu. None = watchdog off (tests /
+        # trusted local backends); env override for ops.
+        import os
+
+        if device_timeout is None:
+            raw = os.environ.get("CORETH_TPU_RESIDENT_TIMEOUT", "")
+            device_timeout = float(raw) if raw else None
+        if device_timeout is not None and device_timeout <= 0:
+            device_timeout = None  # 0 disables the watchdog (config doc)
+        self.device_timeout = device_timeout
+        self.host_mode = False  # True after takeover: CPU-resident
+        self._cpu_threads = os.cpu_count() or 1
         base = base_key if base_key is not None else self.GENESIS
+        # flags BEFORE the genesis commit: a takeover during it must not
+        # have its degradation markers clobbered below
+        self._dirty_since_export = True  # genesis image not yet on disk
+        self._export_degraded = False    # failed write -> next export full
         # the genesis commit (everything is dirty after construction)
-        self._roots: Dict[bytes, bytes] = {
-            base: self.ex.root_bytes(self.trie.commit_resident(self.ex))
-        }
+        self._roots: Dict[bytes, bytes] = {base: self._commit_root()}
         self._by_root: Dict[bytes, List[bytes]] = {
             self._roots[base]: [base]
         }
@@ -86,8 +104,50 @@ class ResidentAccountMirror:
         self._batch_keys: Dict[bytes, frozenset] = {}  # lazy overlay index
         self._applied: List[bytes] = [base]
         self._accepted: set = {base}
-        self._dirty_since_export = True  # genesis image not yet on disk
-        self._export_degraded = False    # failed write -> next export full
+
+    # ---- device-failure takeover (VERDICT r4 #4) -------------------------
+
+    def _commit_root(self) -> bytes:
+        """Settle the trie's current state and return the 32-byte root —
+        on the device while healthy, on the host after takeover. The
+        device path runs under the watchdog; a wedge triggers the
+        takeover and the SAME commit completes on the CPU, so callers
+        never see the failure (the chain does not stall)."""
+        from ..native.mpt import DeviceWedgedError
+
+        if self.host_mode:
+            return self.trie.commit_cpu(threads=self._cpu_threads)
+        try:
+            return self.trie.commit_resident_timed(
+                self.ex, self.device_timeout)
+        except DeviceWedgedError as e:
+            self._take_over_host(str(e))
+            return self.trie.commit_cpu(threads=self._cpu_threads)
+
+    def _take_over_host(self, why: str) -> None:
+        """One-way device -> host switch: rebuild the full host digest
+        cache (the device store is unreachable) and degrade the next
+        export to a full image. The mirror keeps ALL state — records,
+        journal, branch logic — so verify/accept/reject/reorg continue
+        with identical roots; only the hashing runs on the CPU. The
+        reference analog is the lifecycle assumption around
+        core/blockchain.go:1361-1365 that the state backend never
+        vanishes — here it can, and the chain must not stall."""
+        from ..log import get_logger
+        from ..metrics import default_registry
+
+        default_registry.counter("state/resident/device_takeovers").inc(1)
+        get_logger("state").error(
+            "resident device backend wedged (%s) — taking over on the "
+            "host: full rehash of %d nodes, then CPU-resident commits",
+            why, self.trie.num_nodes)
+        self.host_mode = True
+        self.trie.rehash_host(threads=self._cpu_threads)
+        # the export delta marks predate the takeover; write a full
+        # image at the next interval so disk supersedes any device-era
+        # uncertainty
+        self._export_degraded = True
+        self._dirty_since_export = True
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -123,7 +183,7 @@ class ResidentAccountMirror:
             self._switch_to(parent_hash)
         self.trie.checkpoint()
         self.trie.update(updates)
-        root = self.ex.root_bytes(self.trie.commit_resident(self.ex))
+        root = self._commit_root()
         self._dirty_since_export = True
         self._record(block_hash, parent_hash, updates, root)
         return root
@@ -152,7 +212,7 @@ class ResidentAccountMirror:
             self._switch_to(parent_hash)
         self.trie.checkpoint()
         self.trie.update(updates)
-        root = self.ex.root_bytes(self.trie.commit_resident(self.ex))
+        root = self._commit_root()
         self._dirty_since_export = True
         self._record(self.ANON, parent_hash, updates, root)
         return root
@@ -445,9 +505,28 @@ class ResidentAccountMirror:
         # a rewind-only switch leaves the reverted paths dirty (rollback
         # replays through the updater, native/mpt.py rollback): re-commit
         # so digests are settled before the export reads them. A clean
-        # trie plans nothing, so this is free in the common case.
-        self.trie.commit_resident(self.ex)
-        self.trie.absorb_store(np.asarray(self.ex.store))
+        # trie plans nothing, so this is free in the common case. On the
+        # device path the store readback runs under the watchdog too — a
+        # wedge MID-EXPORT takes over exactly like a wedge mid-commit
+        # (the worker only syncs device state; absorb mutates the trie
+        # on THIS thread, so an abandoned worker can't race it).
+        if self.host_mode:
+            self.trie.commit_cpu(threads=self._cpu_threads)
+        else:
+            from ..native.mpt import DeviceWedgedError, _run_with_watchdog
+
+            try:
+                self.trie.commit_resident_timed(self.ex, self.device_timeout)
+                if self.device_timeout is None:
+                    store_np = np.asarray(self.ex.store)
+                else:
+                    store_np = _run_with_watchdog(
+                        lambda: np.asarray(self.ex.store),
+                        self.device_timeout, "store readback")
+                self.trie.absorb_store(store_np)
+            except DeviceWedgedError as e:
+                self._take_over_host(str(e))
+                self.trie.commit_cpu(threads=self._cpu_threads)
         try:
             digs, blob, off = self.trie.export_nodes(
                 delta=not self._export_degraded)
@@ -515,7 +594,7 @@ class ResidentAccountMirror:
             self.trie.checkpoint()
             self.trie.update(self._batch[h])
             self._dirty_since_export = True
-            root = self.ex.root_bytes(self.trie.commit_resident(self.ex))
+            root = self._commit_root()
             if root != self._roots[h]:
                 self.trie.rollback()  # close the scope we just opened
                 raise MirrorError(

@@ -60,8 +60,14 @@ class Config:
     device_hasher: str = "auto"
     # device-resident account trie: block commits run as resident device
     # commits on the account-trie mirror (trie/resident_mirror.py);
-    # requires the native incremental planner (silent fallback otherwise)
-    resident_account_trie: bool = False
+    # requires the native incremental planner (silent fallback otherwise).
+    # "auto" (default): ON exactly when a TPU backend resolves — the
+    # TPU-native path is the production default on TPU hardware, with a
+    # host takeover if the device later fails (resident-commit-timeout)
+    resident_account_trie: "bool | str" = "auto"
+    # watchdog budget (s) per resident device commit; on expiry the
+    # mirror takes over on the host and the chain continues (0 disables)
+    resident_commit_timeout: float = 180.0
 
     # --- tx pool ----------------------------------------------------------
     local_txs_enabled: bool = False
@@ -129,7 +135,11 @@ class Config:
             )
         if self.device_hasher not in ("auto", "planned", "batched", "fused", "off"):
             raise ValueError(f"unknown device-hasher mode {self.device_hasher!r}")
-        if self.resident_account_trie and not self.pruning_enabled:
+        if self.resident_account_trie not in (True, False, "auto"):
+            raise ValueError(
+                f"resident-account-trie must be true, false, or \"auto\" "
+                f"(got {self.resident_account_trie!r})")
+        if self.resident_account_trie is True and not self.pruning_enabled:
             raise ValueError(
                 "resident-account-trie requires pruning: interval "
                 "persistence cannot honor the archival every-block-on-disk "

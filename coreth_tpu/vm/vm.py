@@ -56,8 +56,11 @@ class VMConfig:
     # Trie.hash (trie/trie.go:618-619 parallel-threshold analog); "off": CPU
     device_hasher: str = "auto"
     # device-resident account trie (CacheConfig.resident_account_trie):
-    # per-block account hashing as one resident commit on the mirror
-    resident_account_trie: bool = False
+    # per-block account hashing as one resident commit on the mirror.
+    # "auto": ON when a TPU backend resolves (production default)
+    resident_account_trie: "bool | str" = "auto"
+    # watchdog (s) per resident device commit; expiry -> host takeover
+    resident_commit_timeout: "float | None" = 180.0
 
 
 @dataclass
@@ -103,6 +106,8 @@ class VM:
                 mempool_size=full.tx_pool_global_slots,
                 device_hasher=full.device_hasher,
                 resident_account_trie=full.resident_account_trie,
+                resident_commit_timeout=(
+                    full.resident_commit_timeout or None),
             )
         else:
             from .config import Config as FullConfig
@@ -167,6 +172,7 @@ class VM:
                 commit_interval=self.config.commit_interval,
                 device_hasher=self.config.device_hasher,
                 resident_account_trie=self.config.resident_account_trie,
+                resident_commit_timeout=self.config.resident_commit_timeout,
                 snapshot_limit=self.config.snapshot_limit,
                 trie_dirty_limit=full.trie_dirty_cache * 1024 * 1024,
                 accepted_cache_size=full.accepted_cache_size,
