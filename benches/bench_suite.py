@@ -493,6 +493,48 @@ def bench_10():
     }), flush=True)
 
 
+def bench_11():
+    """Dispatch-fusion A/B (VERDICT r4 #3): the same 20k-leaf planned
+    commit through the old per-segment dispatches vs the fused
+    single-dispatch program, roots asserted against the host oracle.
+    vs_baseline = per-segment time / fused time (>1 = fusion wins; the
+    gap scales with link latency, so the hardware number is the
+    meaningful one — per-segment pays ~n_segments round trips, fused
+    pays one)."""
+    from bench import best_of, build_workload
+    from coreth_tpu.native.mpt import plan_commit
+    from coreth_tpu.ops.keccak_planned import PlannedCommit
+
+    keys, vals, off = build_workload(20000)
+    plan = plan_commit(keys, vals, off)
+    cpu_root = plan.execute_cpu(threads=os.cpu_count() or 1)
+    fused = PlannedCommit(fused=True)
+    perseg = PlannedCommit(fused=False)
+
+    # plan ONCE outside the timer (matching _commit_rates): the timed
+    # region is transfers + dispatch + kernel only, so the fused/per-seg
+    # ratio isolates the dispatch cost this config exists to measure
+    def run(runner):
+        root = plan.execute_planned(runner)
+        assert root == cpu_root, "device root mismatch"
+
+    run(fused)
+    run(perseg)  # compiles
+    t_fused, _ = best_of(lambda: run(fused), 3)
+    t_seg, _ = best_of(lambda: run(perseg), 3)
+    print(json.dumps({
+        "config": 11,
+        "fused_dispatches": fused.last_dispatches,
+        "fused_transfers": fused.last_transfers,
+        "per_segment_dispatches": perseg.last_dispatches,
+        "per_segment_transfers": perseg.last_transfers,
+        "per_segment_nodes_per_sec": round(plan.num_nodes / t_seg, 1),
+    }), flush=True)
+    _emit(11, "fused_commit_nodes_per_sec",
+          round(plan.num_nodes / t_fused, 1), "nodes/s",
+          round(t_seg / t_fused, 3))
+
+
 def main():
     from coreth_tpu.utils import enable_compilation_cache
 
@@ -510,7 +552,7 @@ def main():
     watchdog = PhaseWatchdog(
         time.monotonic() + float(os.environ.get("CORETH_TPU_BENCH_WATCHDOG",
                                                 "1800")))
-    picks = [int(a) for a in sys.argv[1:]] or [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]
+    picks = [int(a) for a in sys.argv[1:]] or list(range(1, 12))
     for i in picks:
         # configs 7/9 run bench.py legs under their own phase watchdogs
         # with larger budgets (900s cold warmup); the outer arm must not

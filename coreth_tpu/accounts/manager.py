@@ -30,8 +30,12 @@ class Manager:
     """accounts.Manager: backends + subscription fan-out."""
 
     def __init__(self, keystore: Optional[KeyStore] = None,
-                 poll_interval: float = 1.0):
+                 poll_interval: float = 1.0, external=None):
         self.keystore = keystore
+        # optional remote-signer backend (accounts/external.py
+        # ExternalBackend — the clef shape): its accounts merge into
+        # listing/lookup; signing goes through the daemon, never here
+        self.external = external
         self.poll_interval = poll_interval
         self._subs: List[Callable[[WalletEvent], None]] = []
         self._known: Dict[bytes, Account] = {}
@@ -46,11 +50,24 @@ class Manager:
 
     def accounts(self) -> List[Account]:
         with self._lock:
-            return sorted(self._known.values(), key=lambda a: a.address)
+            out = dict(self._known)
+        if self.external is not None:
+            try:
+                for acct in self.external.accounts():
+                    out.setdefault(acct.address, acct)
+            except Exception:
+                pass  # daemon down: keystore accounts still serve
+        return sorted(out.values(), key=lambda a: a.address)
 
     def find(self, address: bytes) -> Optional[Account]:
         with self._lock:
-            return self._known.get(address)
+            acct = self._known.get(address)
+        if acct is None and self.external is not None:
+            try:
+                acct = self.external.find(address)
+            except Exception:
+                acct = None
+        return acct
 
     # --- events -----------------------------------------------------------
 

@@ -201,10 +201,16 @@ class BlockChain:
                     # knob (ops/device.py): no jax -> default path. The
                     # probe runs only inside the pruning+planner gates,
                     # so archival/no-native boots never import jax here.
+                    # TIME-BOUNDED: backend discovery through a wedged
+                    # accelerator tunnel can hang indefinitely, and a
+                    # hung boot is worse than the default path — 10s of
+                    # silence means "no usable device".
                     try:
+                        from ..native.mpt import _run_with_watchdog
                         from ..ops.keccak_planned import _tpu_backend
 
-                        resident = _tpu_backend()
+                        resident = _run_with_watchdog(
+                            _tpu_backend, 10.0, "resident auto probe")
                     except Exception:
                         resident = False
                 if resident:

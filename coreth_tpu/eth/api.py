@@ -249,10 +249,20 @@ class EthAPI:
     # --- keystore-backed accounts (internal/ethapi/api.go:276-460) -------
 
     def accounts(self) -> list:
-        """eth_accounts: addresses the node's keystore can sign for."""
+        """eth_accounts: addresses the node can sign for — the local
+        keystore plus the external signer daemon's list (clef role)."""
+        out = []
+        ext = getattr(self.b, "external_signer", None)
+        if ext is not None:
+            try:
+                out = [hb(a) for a in ext.accounts()]
+            except Exception:
+                out = []  # daemon down: keystore accounts still serve
         if self.b.keystore is None:
-            return []
-        return [hb(a.address) for a in self.b.keystore.accounts()]
+            return out
+        seen = set(out)
+        return out + [hb(a.address) for a in self.b.keystore.accounts()
+                      if hb(a.address) not in seen]
 
     def sign(self, address: str, data: str) -> str:
         """eth_sign: personal-message signature by an UNLOCKED account

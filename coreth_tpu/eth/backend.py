@@ -30,12 +30,16 @@ def require_keystore(keystore):
 
 class EthBackend:
     def __init__(self, chain, txpool, allow_unfinalized_queries: bool = False,
-                 keystore=None):
+                 keystore=None, external_signer=None):
         self.chain = chain
         self.txpool = txpool
         self.chain_config = chain.config
         self.allow_unfinalized_queries = allow_unfinalized_queries
         self.keystore = keystore  # accounts.KeyStore | None (node/ role)
+        # accounts/external.ExternalSigner | None (clef daemon): its
+        # accounts list into eth_accounts; signing for them routes over
+        # the daemon's IPC (keystore-external-signer config knob)
+        self.external_signer = external_signer
         self.filters = FilterSystem(self)
         self.gpo = Oracle(self)
 
@@ -185,11 +189,27 @@ class EthBackend:
     def sign_tx_with_keystore(self, obj: dict) -> Transaction:
         from ..accounts.keystore import KeyStoreError
 
+        addr = parse_addr(obj["from"])
+        # external-signer accounts route over the daemon's IPC; local
+        # keystore accounts take precedence (both-known is operator
+        # error and the local key is the cheaper, auditable path)
+        ext = self.external_signer
+        local = (self.keystore is not None
+                 and any(a.address == addr
+                         for a in self.keystore.accounts()))
+        if ext is not None and not local:
+            from ..accounts.external import ExternalSignerError
+
+            try:
+                if ext.contains(addr):
+                    return ext.sign_tx(addr, self.fill_tx(obj),
+                                       self.chain_config.chain_id)
+            except ExternalSignerError as e:
+                raise RPCError(-32000, f"external signer: {e}")
         ks = self.require_keystore()
         tx = self.fill_tx(obj)
         try:
-            return ks.sign_tx(parse_addr(obj["from"]), tx,
-                              self.chain_config.chain_id)
+            return ks.sign_tx(addr, tx, self.chain_config.chain_id)
         except KeyStoreError as e:
             raise RPCError(-32000, str(e))
 

@@ -99,3 +99,13 @@ class Client:
 
     def get_logs(self, criteria: dict) -> List[dict]:
         return self.call_raw("eth_getLogs", criteria)
+
+
+def ws_connect(host: str, port: int, timeout: float = 10.0):
+    """Open a WebSocket client with subscription support
+    (ethclient.go Dial + Subscribe*): returns ethclient.ws.WSEthClient,
+    whose subscribe_new_heads()/subscribe_logs() consume the server's
+    push stream while plain request() calls share the connection."""
+    from .ws import WSEthClient
+
+    return WSEthClient(host, port, timeout=timeout)

@@ -83,7 +83,15 @@ class ResidentAccountMirror:
 
         if device_timeout is None:
             raw = os.environ.get("CORETH_TPU_RESIDENT_TIMEOUT", "")
-            device_timeout = float(raw) if raw else None
+            try:
+                device_timeout = float(raw) if raw else None
+            except ValueError:
+                from ..log import get_logger
+
+                get_logger("state").warning(
+                    "ignoring malformed CORETH_TPU_RESIDENT_TIMEOUT=%r",
+                    raw)
+                device_timeout = None
         if device_timeout is not None and device_timeout <= 0:
             device_timeout = None  # 0 disables the watchdog (config doc)
         self.device_timeout = device_timeout

@@ -437,7 +437,9 @@ def create_handlers(vm, allow_unfinalized_queries: bool = False) -> RPCServer:
         cfg.allow_unfinalized_queries if cfg is not None else False)
 
     backend = EthBackend(vm.blockchain, vm.txpool, allow_unfinalized,
-                         keystore=getattr(vm, "keystore", None))
+                         keystore=getattr(vm, "keystore", None),
+                         external_signer=getattr(vm, "external_signer",
+                                                 None))
     vm.eth_backend = backend
     server = RPCServer()
     eth = EthAPI(backend)

@@ -106,8 +106,10 @@ class VM:
                 mempool_size=full.tx_pool_global_slots,
                 device_hasher=full.device_hasher,
                 resident_account_trie=full.resident_account_trie,
-                resident_commit_timeout=(
-                    full.resident_commit_timeout or None),
+                # pass 0 through untouched: the mirror reads it as
+                # "explicitly disabled" — collapsing it to None would
+                # re-open the env-var override the operator turned off
+                resident_commit_timeout=full.resident_commit_timeout,
             )
         else:
             from .config import Config as FullConfig
@@ -150,6 +152,15 @@ class VM:
             self.keystore = KeyStore(ks_dir)
         else:
             self.keystore = None
+        # external (clef-style) signer daemon (accounts/external/
+        # backend.go role): its accounts merge into eth_accounts, and
+        # eth_signTransaction/sendTransaction for them route over IPC
+        self.external_signer = None
+        ext_path = getattr(self.full_config, "keystore_external_signer", "")
+        if ext_path:
+            from ..accounts.external import ExternalSigner
+
+            self.external_signer = ExternalSigner(ext_path)
 
         clock = self.config.clock or (lambda: self._now())
 
