@@ -41,6 +41,9 @@ from typing import Any, Dict, List, Optional
 
 MAX_SOURCE = 64 * 1024
 DEFAULT_FUEL = 500_000
+# fuel charged per state-accessor call (balance/storage/...): each is a
+# trie read, not an interpreter step — see DSLProgram._eval's Call path
+STATE_BUILTIN_COST = 256
 
 
 class DSLError(Exception):
@@ -168,11 +171,18 @@ def _parse_validated(source: str) -> ast.Module:
 
 
 class DSLProgram:
-    """Compiled (validated) tracer script + its persistent module env."""
+    """Compiled (validated) tracer script + its persistent module env.
 
-    def __init__(self, source: str, fuel_per_call: int = DEFAULT_FUEL):
+    extra_builtins: additional value-only functions exposed to the
+    script (e.g. the tracer's read-only state accessors — goja's `db`
+    object capability, but as plain named functions since the language
+    has no attribute access)."""
+
+    def __init__(self, source: str, fuel_per_call: int = DEFAULT_FUEL,
+                 extra_builtins: Optional[Dict[str, Any]] = None):
         if len(source) > MAX_SOURCE:
             raise DSLError("tracer script too large")
+        self._extra = extra_builtins or {}
         tree = _parse_validated(source)
         self.fuel_per_call = fuel_per_call
         self._fuel = 0
@@ -385,6 +395,15 @@ class DSLProgram:
                 return self._call_fn(self.functions[name], args)
             fn = _BUILTINS.get(name)
             if fn is None:
+                fn = self._extra.get(name)
+                if fn is not None:
+                    # state accessors do trie/disk work, not one
+                    # interpreter step: charge them so fuel still bounds
+                    # a hostile script's REAL cost (~2k reads/hook call)
+                    self._fuel -= STATE_BUILTIN_COST
+                    if self._fuel <= 0:
+                        raise DSLError("tracer fuel exhausted")
+            if fn is None:
                 raise DSLError(f"unknown function {name!r}")
             try:
                 return fn(*args)
@@ -431,11 +450,47 @@ class DSLTracer:
     clean RPC error (goja's tracker.go lifecycle behaves the same)."""
 
     def __init__(self, source: str):
-        self.prog = DSLProgram(source)
+        self._state = [None]  # mutable cell: bound per traced tx
+        self.prog = DSLProgram(
+            source, extra_builtins=self._state_builtins())
         self.failed = False
         self.output = b""
         self.gas_used = 0
         self._err: Optional[str] = None
+
+    def bind_state(self, statedb) -> None:
+        """Attach the traced execution's StateDB so scripts can read
+        accounts (goja's db object: db.getBalance/getNonce/...). The
+        accessors are read-only and value-returning; without a bound
+        state they raise a DSLError the hook isolation absorbs."""
+        self._state[0] = statedb
+
+    def _state_builtins(self) -> dict:
+        cell = self._state
+
+        def need_state():
+            if cell[0] is None:
+                raise DSLError("no state bound to this tracer")
+            return cell[0]
+
+        def _addr(a) -> bytes:
+            if isinstance(a, str):
+                a = bytes.fromhex(a[2:] if a.startswith("0x") else a)
+            if not isinstance(a, bytes) or len(a) != 20:
+                raise DSLError("address must be 20 bytes / 0x-hex")
+            return a
+
+        return {
+            "balance": lambda a: need_state().get_balance(_addr(a)),
+            "nonce": lambda a: need_state().get_nonce(_addr(a)),
+            "code_size": lambda a: len(need_state().get_code(_addr(a))
+                                       or b""),
+            "storage": lambda a, slot: "0x" + (
+                need_state().get_state(
+                    _addr(a), int(slot).to_bytes(32, "big")) or b""
+            ).hex(),
+            "exists": lambda a: need_state().exist(_addr(a)),
+        }
 
     def _call(self, hook: str, arg: dict) -> None:
         if self._err is not None:

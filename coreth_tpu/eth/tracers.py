@@ -205,6 +205,28 @@ class DebugAPI:
     def __init__(self, backend):
         self.b = backend
 
+    def _attach_tracer(self, tracer, state):
+        """The ONE place a tracer binds to an execution: returns
+        (tx_state, cfg, finish_evm) where finish_evm wraps the EVM with
+        call-frame instrumentation when the tracer wants it. Every trace
+        entry point (tx re-exec, parallel worker, traceCall) goes
+        through here so a new tracer type or bind step cannot silently
+        miss a path."""
+        tx_state = state
+        if isinstance(tracer, PrestateTracer):
+            tx_state = tracer.wrap(state)
+        if isinstance(tracer, DSLTracer):
+            tracer.bind_state(state)
+        cfg = Config(tracer=tracer if isinstance(
+            tracer, (StructLogger, DSLTracer)) else None)
+
+        def finish_evm(evm):
+            if isinstance(tracer, (CallTracer, FourByteTracer, DSLTracer)):
+                return _instrument_call_tracer(evm, tracer)
+            return evm
+
+        return tx_state, cfg, finish_evm
+
     def _re_execute(self, blk, upto_index: Optional[int], tracer_factory):
         """Re-run the block's txs from the parent state; attach a fresh
         tracer to each traced tx. Returns list of (tx, tracer, result)."""
@@ -218,15 +240,10 @@ class DebugAPI:
         for i, tx in enumerate(blk.transactions):
             traced = upto_index is None or i == upto_index
             tracer = tracer_factory() if traced else None
-            cfg = Config(tracer=tracer if isinstance(
-                tracer, (StructLogger, DSLTracer)) else None)
+            tx_state, cfg, finish_evm = self._attach_tracer(tracer, state)
             block_ctx = new_block_context(blk.header, chain)
-            tx_state = state
-            if isinstance(tracer, PrestateTracer):
-                tx_state = tracer.wrap(state)
-            evm = EVM(block_ctx, TxContext(), tx_state, self.b.chain_config, cfg)
-            if isinstance(tracer, (CallTracer, FourByteTracer, DSLTracer)):
-                evm = _instrument_call_tracer(evm, tracer)
+            evm = finish_evm(EVM(block_ctx, TxContext(), tx_state,
+                                 self.b.chain_config, cfg))
             state.set_tx_context(tx.hash(), i)
             used = [0]
             receipt = apply_transaction(
@@ -246,15 +263,10 @@ class DebugAPI:
                    tracer_factory):
         """Trace tx [i] from its captured pre-state (runs on a worker)."""
         tracer = tracer_factory()
-        cfg = Config(tracer=tracer if isinstance(
-                tracer, (StructLogger, DSLTracer)) else None)
+        tx_state, cfg, finish_evm = self._attach_tracer(tracer, pre_state)
         block_ctx = new_block_context(blk.header, chain)
-        tx_state = pre_state
-        if isinstance(tracer, PrestateTracer):
-            tx_state = tracer.wrap(pre_state)
-        evm = EVM(block_ctx, TxContext(), tx_state, self.b.chain_config, cfg)
-        if isinstance(tracer, (CallTracer, FourByteTracer, DSLTracer)):
-            evm = _instrument_call_tracer(evm, tracer)
+        evm = finish_evm(EVM(block_ctx, TxContext(), tx_state,
+                             self.b.chain_config, cfg))
         pre_state.set_tx_context(tx.hash(), i)
         used = [0]
         receipt = apply_transaction(
@@ -313,11 +325,50 @@ class DebugAPI:
         _, tracer, _ = results[-1]
         return tracer.result()
 
+    def traceBlockByHash(self, block_hash: str, config: dict = None) -> list:
+        """debug_traceBlockByHash (eth/tracers/api.go TraceBlockByHash):
+        same as traceBlockByNumber, addressed by hash."""
+        blk = self.b.chain.get_block(parse_bytes(block_hash))
+        if blk is None:
+            raise RPCError(-32000, "block not found")
+        return self._trace_block(blk, config or {})
+
+    def traceCall(self, call_obj: dict, tag: str = "latest",
+                  config: dict = None) -> dict:
+        """debug_traceCall (eth/tracers/api.go TraceCall): run an
+        eth_call-shaped message against [tag]'s state with a tracer
+        attached — no transaction, no state commitment."""
+        from ..core.state_processor import new_block_context
+        from ..core.state_transition import apply_message
+
+        config = config or {}
+        blk = self.b.block_by_tag(tag)
+        if blk is None:
+            raise RPCError(-32000, "block not found")
+        tracer = self._tracer_factory(config)()
+        state = self.b.chain.state_at(blk.root)
+        tx_state, cfg, finish_evm = self._attach_tracer(tracer, state)
+        cfg.no_base_fee = True  # eth_call semantics (backend.do_call)
+        msg = self.b._call_msg(call_obj, blk.gas_limit)
+        evm = finish_evm(EVM(
+            new_block_context(blk.header, self.b.chain),
+            TxContext(origin=msg.from_, gas_price=msg.gas_price),
+            tx_state, self.b.chain_config, cfg))
+        result = apply_message(evm, msg, GasPool(2**63))
+        if isinstance(tracer, StructLogger):
+            tracer.gas_used = result.used_gas
+            tracer.failed = result.err is not None
+            tracer.output = result.return_data or b""
+        return tracer.result()
+
     def traceBlockByNumber(self, tag: str, config: dict = None) -> list:
         config = config or {}
         blk = self.b.block_by_tag(tag)
         if blk is None:
             raise RPCError(-32000, "block not found")
+        return self._trace_block(blk, config)
+
+    def _trace_block(self, blk, config: dict) -> list:
         factory = self._tracer_factory(config)
         workers = int(config.get("parallelWorkers", 0) or 0)
         if workers > 1 and len(blk.transactions) > 1:

@@ -241,6 +241,42 @@ class TestDebugTracers:
         assert len(traces) == 1
         assert traces[0]["txHash"] == "0x" + t2.hash().hex()
 
+    def test_trace_block_by_hash(self, live_vm):
+        vm, server, _, (t2, b2) = live_vm
+        traces = rpc(server, "debug_traceBlockByHash",
+                     "0x" + b2.id().hex())
+        assert len(traces) == 1
+        assert traces[0]["txHash"] == "0x" + t2.hash().hex()
+
+    def test_trace_call(self, live_vm):
+        """debug_traceCall: trace an eth_call-shaped message (no tx, no
+        state commitment) with both the struct logger and a DSL script
+        that reads state through the bound accessors."""
+        vm, server, _, _ = live_vm
+        call = {"to": "0x" + (b"\xee" * 20).hex(), "gas": hex(200000)}
+        out = rpc(server, "debug_traceCall", call, "latest")
+        assert out["structLogs"] and not out["failed"]
+        ops = [e["op"] for e in out["structLogs"]]
+        assert "LOG1" in ops
+        # DSL tracer with state access: count ops AND read the callee's
+        # code size + the caller-funded balance through the db builtins
+        call_from = dict(call, **{"from": "0x" + ADDR.hex()})
+        script = (
+            "stats = {\"steps\": 0, \"codeSize\": 0, \"bal\": 0}\n"
+            "def enter(frame):\n"
+            "    stats[\"codeSize\"] = code_size(frame[\"to\"])\n"
+            "    stats[\"bal\"] = balance(frame[\"from\"])\n"
+            "def step(log):\n"
+            "    stats[\"steps\"] = stats[\"steps\"] + 1\n"
+            "def result():\n    return stats\n")
+        stats = rpc(server, "debug_traceCall", call_from, "latest",
+                    {"tracer": script})
+        assert stats["steps"] == len(ops)
+        assert stats["codeSize"] == len(EMITTER)
+        # the funded test account's REAL balance, not a default
+        assert stats["bal"] == vm.blockchain.state().get_balance(ADDR)
+        assert stats["bal"] > 0
+
     def test_dump_block_and_account_range(self, live_vm):
         """debug_dumpBlock / debug_accountRange (core/state/dump.go:139
         DumpToCollector/IteratorDump): full dump, paging, code opt-in."""

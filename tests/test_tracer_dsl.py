@@ -99,6 +99,28 @@ class TestSandbox:
         with pytest.raises(DSLError, match="too large"):
             p2.call("f")
 
+    def test_state_accessors_cost_real_fuel(self):
+        # balance/storage/... are trie reads: a hostile accessor loop
+        # must exhaust fuel after ~fuel/256 calls, not hammer the disk
+        from coreth_tpu.eth.tracer_dsl import DSLProgram, STATE_BUILTIN_COST
+
+        calls = [0]
+
+        def fake_balance(_a):
+            calls[0] += 1
+            return 0
+
+        p = DSLProgram(
+            "def spin():\n"
+            "    i = 0\n"
+            "    while True:\n"
+            "        x = balance(\"0x\" + \"ee\")\n"
+            "        i = i + 1\n",
+            extra_builtins={"balance": fake_balance})
+        with pytest.raises(DSLError, match="fuel"):
+            p.call("spin")
+        assert calls[0] <= 500_000 // STATE_BUILTIN_COST + 1
+
     def test_recursion_bounded(self):
         p = DSLProgram("def f():\n    return f()\n")
         with pytest.raises(DSLError, match="depth"):
@@ -195,6 +217,16 @@ class TestEndToEnd:
             assert stats["frames"] >= 1
             assert stats["maxDepth"] >= 1
             json.dumps(stats)  # JSON-serializable end to end
+
+            # state accessors bind per traced tx (_re_execute seam)
+            state_script = (
+                "seen = {\"bal\": -1}\n"
+                "def enter(frame):\n"
+                "    seen[\"bal\"] = balance(frame[\"from\"])\n"
+                "def result():\n    return seen\n")
+            out = rpc(server, "debug_traceTransaction",
+                      "0x" + t2.hash().hex(), {"tracer": state_script})
+            assert out["bal"] > 0  # sender had funds at trace time
 
             # a bad script fails at registration with a clean RPC error
             with pytest.raises(RuntimeError, match="bad tracer script"):
