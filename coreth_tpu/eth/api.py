@@ -112,6 +112,50 @@ class EthAPI:
         blk = self.b.block_by_tag(block)
         return None if blk is None else hx(len(blk.transactions))
 
+    def getBlockTransactionCountByHash(self, block_hash: str):
+        blk = self.b.chain.get_block(parse_bytes(block_hash))
+        return None if blk is None else hx(len(blk.transactions))
+
+    def getHeaderByNumber(self, block: str):
+        """eth_getHeaderByNumber (api.go GetHeaderByNumber): the block
+        marshaling minus the tx list."""
+        blk = self.b.block_by_tag(block)
+        if blk is None:
+            return None
+        out = self._marshal_block(blk, False)
+        out.pop("transactions", None)
+        return out
+
+    def getHeaderByHash(self, block_hash: str):
+        blk = self.b.chain.get_block(parse_bytes(block_hash))
+        if blk is None:
+            return None
+        out = self._marshal_block(blk, False)
+        out.pop("transactions", None)
+        return out
+
+    def baseFee(self):
+        """eth_baseFee (coreth-only, api.go BaseFee): the last accepted
+        block's base fee."""
+        fee = self.b.last_accepted_block().base_fee
+        return hx(fee) if fee is not None else None
+
+    # --- uncles: Avalanche consensus has none (api.go returns empty) -----
+
+    def getUncleCountByBlockNumber(self, block: str):
+        blk = self.b.block_by_tag(block)
+        return None if blk is None else hx(0)
+
+    def getUncleCountByBlockHash(self, block_hash: str):
+        blk = self.b.chain.get_block(parse_bytes(block_hash))
+        return None if blk is None else hx(0)
+
+    def getUncleByBlockNumberAndIndex(self, block: str, index: str):
+        return None
+
+    def getUncleByBlockHashAndIndex(self, block_hash: str, index: str):
+        return None
+
     def _marshal_block(self, blk: Block, full_txs: bool) -> dict:
         h = blk.header
         out = {
@@ -162,6 +206,47 @@ class EthAPI:
             return None
         tx, blk, index = found
         return self._marshal_tx(tx, blk, index)
+
+    @staticmethod
+    def _tx_in_block(blk, index: str):
+        """Bounds-safe tx lookup: None for a missing block OR any
+        out-of-range index (incl. negative — Python indexing must not
+        leak through; geth returns null)."""
+        if blk is None:
+            return None, 0
+        i = parse_hex(index)
+        if not 0 <= i < len(blk.transactions):
+            return None, 0
+        return blk.transactions[i], i
+
+    def _tx_at(self, blk, index: str):
+        tx, i = self._tx_in_block(blk, index)
+        return None if tx is None else self._marshal_tx(tx, blk, i)
+
+    def getTransactionByBlockNumberAndIndex(self, block: str, index: str):
+        return self._tx_at(self.b.block_by_tag(block), index)
+
+    def getTransactionByBlockHashAndIndex(self, block_hash: str,
+                                          index: str):
+        return self._tx_at(self.b.chain.get_block(parse_bytes(block_hash)),
+                           index)
+
+    # --- raw (RLP) transaction access (api.go GetRawTransaction*) --------
+
+    def getRawTransactionByHash(self, tx_hash: str):
+        found = self.b.tx_by_hash(parse_bytes(tx_hash))
+        return None if found is None else hb(found[0].encode())
+
+    def getRawTransactionByBlockNumberAndIndex(self, block: str,
+                                               index: str):
+        tx, _ = self._tx_in_block(self.b.block_by_tag(block), index)
+        return None if tx is None else hb(tx.encode())
+
+    def getRawTransactionByBlockHashAndIndex(self, block_hash: str,
+                                             index: str):
+        tx, _ = self._tx_in_block(
+            self.b.chain.get_block(parse_bytes(block_hash)), index)
+        return None if tx is None else hb(tx.encode())
 
     def getTransactionReceipt(self, tx_hash: str):
         found = self.b.tx_by_hash(parse_bytes(tx_hash))
@@ -232,7 +317,7 @@ class EthAPI:
     # --- execution --------------------------------------------------------
 
     def call(self, call_obj: dict, block: str = "latest") -> str:
-        result = self.b.do_call(call_obj, block)
+        result, _, _ = self.b.do_call(call_obj, block)
         if result.err is not None:
             if vmerrs.is_revert(result.err):
                 raise RPCError(3, "execution reverted", hb(result.return_data))
@@ -241,6 +326,119 @@ class EthAPI:
 
     def estimateGas(self, call_obj: dict, block: str = "latest") -> str:
         return hx(self.b.estimate_gas(call_obj, block))
+
+    def callDetailed(self, call_obj: dict, block: str = "latest") -> dict:
+        """eth_callDetailed (coreth-only, api.go:1112 CallDetailed):
+        like call but returns gas used and the error message instead of
+        failing the RPC."""
+        result, _, _ = self.b.do_call(call_obj, block)
+        out = {"returnData": hb(result.return_data),
+               "usedGas": hx(result.used_gas)}
+        if result.err is not None:
+            out["errorMessage"] = str(result.err)
+        return out
+
+    def createAccessList(self, call_obj: dict,
+                         block: str = "latest") -> dict:
+        """eth_createAccessList (api.go CreateAccessList): execute the
+        call recording every touched (account, slot) outside the
+        sender/recipient/precompiles and return it as an EIP-2930
+        access list plus the plain call's gas. Single recording pass
+        (the reference iterates to a fixpoint because using the list
+        changes warm/cold gas; the touched-set is a valid list either
+        way)."""
+        from .tracers import PrestateTracer
+
+        recorder = PrestateTracer()
+        result, msg, blk = self.b.do_call(call_obj, block,
+                                          wrap_state=recorder.wrap)
+        # sender, recipient, precompiles, and the COINBASE (touched by
+        # the fee payout, not by the call) never belong in the list
+        exclude = {msg.from_, msg.to, blk.header.coinbase}
+        exclude |= {i.to_bytes(20, "big") for i in range(1, 10)}  # 0x1-0x9
+        exclude |= {  # Avalanche stateful precompiles (contracts.go)
+            bytes.fromhex("0100000000000000000000000000000000000001"),
+            bytes.fromhex("0100000000000000000000000000000000000002"),
+        }
+        access = []
+        for addr, acct in recorder.accounts.items():
+            if addr in exclude:
+                continue
+            access.append({
+                "address": hb(addr),
+                "storageKeys": [hb(k.rjust(32, b"\x00"))
+                                for k in acct["storage"]],
+            })
+        out = {"accessList": access, "gasUsed": hx(result.used_gas)}
+        if result.err is not None:
+            out["error"] = str(result.err)
+        return out
+
+    def fillTransaction(self, tx_obj: dict) -> dict:
+        """eth_fillTransaction (api.go FillTransaction): apply
+        setDefaults (nonce/fees/gas) and return the UNSIGNED tx
+        (marshaled by hand — _marshal_tx recovers a sender the
+        unsigned payload does not have)."""
+        tx = self.b.fill_tx(tx_obj)
+        out = {
+            "type": hx(tx.type),
+            "nonce": hx(tx.nonce),
+            "gas": hx(tx.gas),
+            "to": hb(tx.to) if tx.to else None,
+            "value": hx(tx.value),
+            "input": hb(tx.data or b""),
+            "chainId": hx(tx.chain_id),
+        }
+        if tx.type in (0, 1):
+            out["gasPrice"] = hx(tx.gas_price)
+        else:
+            out["maxFeePerGas"] = hx(tx.max_fee)
+            out["maxPriorityFeePerGas"] = hx(tx.max_priority_fee)
+        return {"raw": hb(tx.encode()), "tx": out}
+
+    def pendingTransactions(self) -> list:
+        """eth_pendingTransactions (api.go PendingTransactions): pool
+        txs whose sender the node can sign for."""
+        mine = {a.address for a in (self.b.keystore.accounts()
+                                    if self.b.keystore else [])}
+        ext = getattr(self.b, "external_signer", None)
+        if ext is not None:
+            try:
+                mine |= set(ext.accounts())
+            except Exception:
+                pass
+        out = []
+        for addr, txs in self.b.txpool.pending_txs().items():
+            if addr in mine:
+                out.extend(self._marshal_tx(t, None, 0) for t in txs)
+        return out
+
+    def resend(self, tx_obj: dict, gas_price: str = None,
+               gas_limit: str = None) -> str:
+        """eth_resend (api.go Resend): re-sign the (from, nonce) pending
+        tx with new fees and replace it in the pool."""
+        if not tx_obj.get("nonce"):
+            raise RPCError(-32602, "nonce required for resend")
+        from_ = parse_addr(tx_obj["from"]) if tx_obj.get("from") else None
+        nonce = parse_hex(tx_obj["nonce"])
+        pending = self.b.txpool.pending_txs().get(from_, [])
+        if not any(t.nonce == nonce for t in pending):
+            # the reference's Resend errors for a tx that is not in the
+            # pool (already mined / never sent) instead of minting a
+            # brand-new transaction the caller never intended
+            raise RPCError(-32000,
+                           f"transaction (nonce {nonce}) not found in "
+                           "the pool")
+        obj = dict(tx_obj)
+        if gas_price:
+            obj["gasPrice"] = gas_price
+            obj.pop("maxFeePerGas", None)
+            obj.pop("maxPriorityFeePerGas", None)
+        if gas_limit:
+            obj["gas"] = gas_limit
+        tx = self.b.sign_tx_with_keystore(obj)
+        self.b.send_tx(tx)  # same (from, nonce): pool price-bump replace
+        return hb(tx.hash())
 
     def getLogs(self, filter_obj: dict) -> list:
         logs = self.b.filters.get_logs(filter_obj)

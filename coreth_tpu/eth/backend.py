@@ -119,11 +119,17 @@ class EthBackend:
             value=value, data=data, skip_account_checks=True,
         )
 
-    def do_call(self, call_obj: dict, tag: str):
+    def do_call(self, call_obj: dict, tag: str, wrap_state=None):
+        """eth_call semantics. wrap_state: optional StateDB decorator
+        (e.g. an access recorder) applied before execution — the ONE
+        call-execution recipe shared by eth_call, callDetailed, and
+        createAccessList."""
         blk = self.block_by_tag(tag)
         if blk is None:
             raise RPCError(-32000, "block not found")
         state = self.chain.state_at(blk.root)
+        if wrap_state is not None:
+            state = wrap_state(state)
         msg = self._call_msg(call_obj, blk.gas_limit)
         from ..core.state_processor import new_block_context
 
@@ -132,7 +138,7 @@ class EthBackend:
             TxContext(origin=msg.from_, gas_price=msg.gas_price),
             state, self.chain_config, Config(no_base_fee=True),
         )
-        return apply_message(evm, msg, GasPool(2**63))
+        return apply_message(evm, msg, GasPool(2**63)), msg, blk
 
     # --- keystore-backed signing (internal/ethapi/api.go:276-460) --------
 
@@ -283,7 +289,7 @@ class EthBackend:
             obj = dict(call_obj)
             obj["gas"] = hex(gas)
             try:
-                res = self.do_call(obj, tag)
+                res, _, _ = self.do_call(obj, tag)
             except RPCError:
                 return False
             return res.err is None

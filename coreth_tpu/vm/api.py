@@ -362,6 +362,31 @@ class TxPoolAPI:
             }
         return out
 
+    def contentFrom(self, address: str) -> dict:
+        """txpool_contentFrom (api.go ContentFrom): one account's slice
+        of content."""
+        from ..eth.api import parse_addr
+
+        addr = parse_addr(address)
+        txs = self.b.txpool.pending_txs().get(addr, [])
+        return {"pending": {str(t.nonce): hb(t.hash()) for t in txs},
+                "queued": {}}
+
+    def inspect(self) -> dict:
+        """txpool_inspect (api.go Inspect): human-oriented one-line tx
+        summaries, geth's '<to>: <value> wei + <gas> gas x <price> wei'
+        format."""
+        out = {"pending": {}, "queued": {}}
+        for addr, txs in self.b.txpool.pending_txs().items():
+            out["pending"][hb(addr)] = {
+                str(t.nonce): (
+                    f"{hb(t.to) if t.to else 'contract creation'}: "
+                    f"{t.value} wei + {t.gas} gas x "
+                    f"{t.gas_fee_cap} wei")
+                for t in txs
+            }
+        return out
+
 
 class NetAPI:
     def __init__(self, network_id: int):

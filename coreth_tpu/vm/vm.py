@@ -497,7 +497,7 @@ class VM:
             self.eth_backend = backend
         try:
             call_obj = _json.loads(msg.request_args.decode())
-            result = backend.do_call(call_obj, "latest")
+            result, _, _ = backend.do_call(call_obj, "latest")
         except Exception as e:  # noqa: BLE001 — errors travel in-band
             return EthCallResponse(result=b"", error=str(e).encode()).encode()
         if result.err is not None:
