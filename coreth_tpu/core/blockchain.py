@@ -185,6 +185,11 @@ class BlockChain:
         # guarantees it exists), then route account-trie lifecycle through
         # it. Genesis/recovery writes above intentionally used the default
         # writer; history before this point lives on disk.
+        # recent insertion failures for debug_getBadBlocks (core
+        # reportBlock keeps a similar bounded set)
+        from collections import deque
+
+        self.bad_blocks = deque(maxlen=10)
         self.mirror = None
         # resident mode is a PRUNING policy (interval persistence): under
         # pruning=False the archive guarantee — every block's state on
@@ -426,11 +431,21 @@ class BlockChain:
     def insert_block(self, block: Block) -> None:
         """InsertBlockManual(writes=True) (blockchain.go:1234-1389)."""
         with self.chainmu:
-            self._insert_block(block, writes=True)
+            self._insert_checked(block, writes=True)
 
     def insert_block_manual(self, block: Block, writes: bool) -> None:
         with self.chainmu:
+            self._insert_checked(block, writes)
+
+    def _insert_checked(self, block: Block, writes: bool) -> None:
+        """Record blocks that FAIL insertion in the bad-block ring
+        (eth/api.go GetBadBlocks / core reportBlock): operators debug
+        bad-root/gas-mismatch blocks from debug_getBadBlocks."""
+        try:
             self._insert_block(block, writes)
+        except Exception as e:
+            self.bad_blocks.append((block, f"{type(e).__name__}: {e}"))
+            raise
 
     def _insert_block(self, block: Block, writes: bool) -> None:
         from ..metrics import default_registry as _metrics

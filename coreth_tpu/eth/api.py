@@ -134,6 +134,17 @@ class EthAPI:
         out.pop("transactions", None)
         return out
 
+    def coinbase(self):
+        """eth_coinbase (eth/api.go Coinbase/Etherbase): the address
+        blocks credit fees to — the blackhole under Avalanche's fee
+        burn."""
+        from ..miner.worker import BLACKHOLE_ADDR
+
+        return hb(BLACKHOLE_ADDR)
+
+    def etherbase(self):
+        return self.coinbase()
+
     def baseFee(self):
         """eth_baseFee (coreth-only, api.go BaseFee): the last accepted
         block's base fee."""
@@ -352,14 +363,23 @@ class EthAPI:
         recorder = PrestateTracer()
         result, msg, blk = self.b.do_call(call_obj, block,
                                           wrap_state=recorder.wrap)
-        # sender, recipient, precompiles, and the COINBASE (touched by
-        # the fee payout, not by the call) never belong in the list
-        exclude = {msg.from_, msg.to, blk.header.coinbase}
-        exclude |= {i.to_bytes(20, "big") for i in range(1, 10)}  # 0x1-0x9
-        exclude |= {  # Avalanche stateful precompiles (contracts.go)
-            bytes.fromhex("0100000000000000000000000000000000000001"),
-            bytes.fromhex("0100000000000000000000000000000000000002"),
-        }
+        # sender, recipient (or the derived CREATE address), the
+        # active precompile set, and the COINBASE (touched by the fee
+        # payout, not by the call) never belong in the list (geth's
+        # AccessListTracer exclusion)
+        to = msg.to
+        if to is None:
+            from ..core.types import create_address
+
+            to = create_address(
+                msg.from_,
+                self.b.chain.state_at(blk.root).get_nonce(msg.from_))
+        exclude = {msg.from_, to, blk.header.coinbase}
+        from ..evm.precompiles import active_precompiles
+
+        rules = self.b.chain_config.rules(blk.header.number,
+                                          blk.header.time)
+        exclude |= set(active_precompiles(rules).keys())
         access = []
         for addr, acct in recorder.accounts.items():
             if addr in exclude:

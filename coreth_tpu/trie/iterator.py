@@ -18,6 +18,54 @@ def _strip_term(hexkey: bytes) -> bytes:
     return hexkey[:-1] if hexkey and hexkey[-1] == 16 else hexkey
 
 
+def diff_leaves(trie_a: Trie, trie_b: Trie):
+    """Yield (key_bytes, val_a, val_b) for every leaf whose value differs
+    between the two tries, PRUNING shared subtrees by node hash — the
+    role of the reference's trie.NewDifferenceIterator
+    (trie/iterator.go): cost is O(changed subtrees), not O(total
+    leaves). Either val may be None (key only on one side)."""
+
+    def expand(trie, node, path):
+        """-> (terminal value | None, {nibble: child}) one level down.
+        ShortNodes are consumed one nibble at a time so both sides stay
+        aligned on the SAME path regardless of structural shape."""
+        if isinstance(node, HashNode):
+            node = trie._resolve(node, path)
+        if node is None:
+            return None, {}
+        if isinstance(node, ValueNode):
+            return bytes(node), {}
+        if isinstance(node, ShortNode):
+            k = node.key
+            if len(k) == 1 and k[0] == 16:  # terminator only: a value
+                v = node.val
+                return (bytes(v) if isinstance(v, ValueNode) else None), {}
+            child = (ShortNode(k[1:], node.val) if len(k) > 1
+                     else node.val)
+            return None, {k[0]: child}
+        if isinstance(node, FullNode):
+            v = node.children[16]
+            kids = {i: c for i, c in enumerate(node.children[:16])
+                    if c is not None}
+            return (bytes(v) if v is not None else None), kids
+        raise TypeError(f"unexpected node {type(node)}")
+
+    def walk(na, nb, path):
+        if na is None and nb is None:
+            return
+        if (isinstance(na, HashNode) and isinstance(nb, HashNode)
+                and bytes(na) == bytes(nb)):
+            return  # identical subtree: the whole point of the pruning
+        va, ca = expand(trie_a, na, path)
+        vb, cb = expand(trie_b, nb, path)
+        if va != vb:
+            yield hex_to_keybytes(path), va, vb
+        for nib in sorted(set(ca) | set(cb)):
+            yield from walk(ca.get(nib), cb.get(nib), path + bytes([nib]))
+
+    yield from walk(trie_a.root, trie_b.root, b"")
+
+
 def iterate_leaves(
     trie: Trie, start: Optional[bytes] = None
 ) -> Iterator[Tuple[bytes, bytes]]:

@@ -285,6 +285,51 @@ class AdminAPI:
         self.log_level = level
         return True
 
+    # --- chain export/import (eth/api.go Admin ExportChain/ImportChain) --
+
+    def exportChain(self, path: str, first: int = None,
+                    last: int = None) -> bool:
+        """admin_exportChain: write blocks [first..last] (accepted chain,
+        defaults: genesis..head) as length-prefixed RLP to [path]."""
+        import struct
+
+        chain = self.vm.blockchain
+        lo = int(first) if first is not None else 0
+        hi = int(last) if last is not None else chain.last_accepted.number
+        if lo > hi:
+            raise RPCError(-32602, "first must be <= last")
+        with open(path, "wb") as f:
+            for n in range(lo, hi + 1):
+                blk = chain.get_block_by_number(n)
+                if blk is None:
+                    raise RPCError(-32000, f"block {n} not found")
+                raw = blk.encode()
+                f.write(struct.pack(">I", len(raw)) + raw)
+        return True
+
+    def importChain(self, path: str) -> bool:
+        """admin_importChain: insert + accept each block from an
+        exportChain file (blocks already known are skipped, like the
+        reference's hasAllBlocks fast path)."""
+        import struct
+
+        from ..core.types import Block
+
+        chain = self.vm.blockchain
+        with open(path, "rb") as f:
+            while True:
+                hdr = f.read(4)
+                if not hdr:
+                    break
+                (n,) = struct.unpack(">I", hdr)
+                blk = Block.decode(f.read(n))
+                if chain.get_block(blk.hash()) is not None:
+                    continue  # already have it
+                chain.insert_block(blk)
+                chain.accept(blk)
+        chain.drain_acceptor_queue()
+        return True
+
     def startCPUProfiler(self) -> bool:
         """Statistical profiler sampling ALL thread stacks (RPC handlers
         run on per-request threads, so a deterministic per-thread profiler

@@ -403,3 +403,55 @@ class TestCleanCacheJournal:
         size1 = tdb2._clean_size
         assert tdb2.load_clean_cache(path) == 0  # all duplicates
         assert tdb2._clean_size == size1
+
+
+def test_diff_leaves_prunes_and_finds_changes():
+    """trie.NewDifferenceIterator role (iterator.diff_leaves): exact
+    changed-leaf set between two versions of a trie, including one-sided
+    keys, with shared subtrees pruned by hash."""
+    import random
+
+    from coreth_tpu.ethdb import MemoryDB
+    from coreth_tpu.trie.iterator import diff_leaves
+    from coreth_tpu.trie.triedb import TrieDatabase
+
+    rng = random.Random(11)
+    db = TrieDatabase(MemoryDB())
+    items = {rng.randbytes(32): rng.randbytes(40) for _ in range(300)}
+    from coreth_tpu.trie.node import EMPTY_ROOT
+
+    t1 = db.open_trie(EMPTY_ROOT)
+    for k, v in items.items():
+        t1.update(k, v)
+    from coreth_tpu.trie import MergedNodeSet
+
+    root1, ns1 = t1.commit(collect_leaf=False)
+    m1 = MergedNodeSet(); m1.merge(ns1)
+    db.update(root1, EMPTY_ROOT, m1)
+
+    keys = list(items)
+    changed = {keys[i]: b"NEW" + bytes(37) for i in range(0, 10)}
+    added = {rng.randbytes(32): rng.randbytes(40) for _ in range(5)}
+    removed = set(keys[10:15])
+    t2 = db.open_trie(root1)
+    for k, v in {**changed, **added}.items():
+        t2.update(k, v)
+    for k in removed:
+        t2.delete(k)
+    root2, ns2 = t2.commit(collect_leaf=False)
+    m2 = MergedNodeSet(); m2.merge(ns2)
+    db.update(root2, root1, m2)
+
+    a = db.open_trie(root1)
+    b = db.open_trie(root2)
+    got = {k: (va, vb) for k, va, vb in diff_leaves(a, b)}
+    want_keys = set(changed) | set(added) | removed
+    assert set(got) == want_keys
+    for k in changed:
+        assert got[k] == (items[k], changed[k])
+    for k in added:
+        assert got[k] == (None, added[k])
+    for k in removed:
+        assert got[k] == (items[k], None)
+    # empty diff when both sides are the same root
+    assert list(diff_leaves(db.open_trie(root2), db.open_trie(root2))) == []
