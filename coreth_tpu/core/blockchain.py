@@ -441,6 +441,11 @@ class BlockChain:
         """Record blocks that FAIL insertion in the bad-block ring
         (eth/api.go GetBadBlocks / core reportBlock): operators debug
         bad-root/gas-mismatch blocks from debug_getBadBlocks."""
+        if self.get_header(block.header.parent_hash) is None:
+            # unknown ancestor is an ORDERING condition, not a bad block
+            # (geth's reportBlock is only reached by validation errors;
+            # ErrUnknownAncestor takes the unknown-block path)
+            raise ChainError("unknown ancestor")
         try:
             self._insert_block(block, writes)
         except Exception as e:

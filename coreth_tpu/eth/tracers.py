@@ -200,7 +200,10 @@ class _PrestateProxy:
 
 
 class DebugAPI:
-    """debug namespace: traceTransaction/traceBlockByNumber/traceCall."""
+    """debug namespace: traceTransaction / traceBlockByNumber /
+    traceBlockByHash / traceCall (struct, call, prestate, 4byte, and
+    sandboxed DSL tracers), dumpBlock / accountRange, storageRangeAt,
+    getModifiedAccountsByNumber/ByHash, getBadBlocks."""
 
     def __init__(self, backend):
         self.b = backend
@@ -257,7 +260,7 @@ class DebugAPI:
                 results.append((tx, tracer, receipt))
             if upto_index is not None and i == upto_index:
                 break
-        return results
+        return results, state
 
     def _trace_one(self, blk, chain, pre_state, gas_left, i, tx,
                    tracer_factory):
@@ -319,7 +322,7 @@ class DebugAPI:
             raise RPCError(-32000, "transaction not found")
         tx, blk, index = found
         factory = self._tracer_factory(config)
-        results = self._re_execute(blk, index, factory)
+        results, _ = self._re_execute(blk, index, factory)
         if not results:
             raise RPCError(-32000, "trace produced no result")
         _, tracer, _ = results[-1]
@@ -378,7 +381,7 @@ class DebugAPI:
             # and threads can overlap (C-backed tracers / multi-core)
             results = self._re_execute_parallel(blk, factory, workers=workers)
         else:
-            results = self._re_execute(blk, None, factory)
+            results, _ = self._re_execute(blk, None, factory)
         return [
             {"txHash": hb(tx.hash()), "result": tracer.result()}
             for tx, tracer, _ in results
@@ -403,20 +406,21 @@ class DebugAPI:
         parent = chain.get_header(blk.parent_hash)
         if parent is None:
             raise RPCError(-32000, "parent block not found")
-        state = chain.state_at(parent.root)
-        gp = GasPool(blk.gas_limit)
-        for i, tx in enumerate(blk.transactions[:max(0, int(tx_index))]):
-            block_ctx = new_block_context(blk.header, chain)
-            evm = EVM(block_ctx, TxContext(), state, self.b.chain_config,
-                      Config())
-            state.set_tx_context(tx.hash(), i)
-            apply_transaction(self.b.chain_config, chain, evm, gp, state,
-                              blk.header, tx, [0])
+        n = max(0, int(tx_index))
+        if n == 0:
+            state = chain.state_at(parent.root)
+        else:
+            # the ONE replay recipe (_re_execute) applied to the prefix
+            _, state = self._re_execute(blk, n - 1, lambda: None)
         addr = parse_addr(contract)
-        obj = state._get_state_object(addr)
+        # deleted objects matter: a prefix SELFDESTRUCT must yield EMPTY
+        # storage, not the parent trie's stale image
+        obj = state._get_deleted_state_object(addr)
         tr = None
         acct_root = None
-        if obj is not None and not obj.deleted:
+        if obj is not None and getattr(obj, "deleted", False):
+            return {"storage": {}, "nextKey": None}
+        if obj is not None:
             # overlays pending storage when the replayed prefix wrote
             # any; None when untouched (lazy trie never opened)
             tr = obj.update_trie()

@@ -441,6 +441,46 @@ class TestEthParitySweep:
         assert vals == {"0x" + (0xAA).to_bytes(32, "big").hex(),
                         "0x" + (0xBB).to_bytes(32, "big").hex()}
 
+    def test_storage_range_after_selfdestruct(self, live_vm):
+        """A prefix SELFDESTRUCT must yield EMPTY storage, not the
+        parent trie's stale image (the deleted-object path)."""
+        vm, server, _, _ = live_vm
+        signer = Signer(43112)
+        runtime = bytes([0x73]) + b"\x00" * 20 + bytes([0xFF])  # SELFDESTRUCT(0)
+        init = bytes([OP.PUSH1, 0xAA, OP.PUSH1, 0x00, OP.SSTORE])
+        off = len(init) + 12
+        init += bytes([OP.PUSH1, len(runtime), OP.PUSH1, off, OP.PUSH1, 0,
+                       OP.CODECOPY, OP.PUSH1, len(runtime), OP.PUSH1, 0,
+                       OP.RETURN]) + runtime
+        nonce = vm.txpool.nonce(ADDR)
+        t = signer.sign(Transaction(type=2, chain_id=43112, nonce=nonce,
+                                    max_fee=10**12, max_priority_fee=10**9,
+                                    gas=300_000, to=None, value=0,
+                                    data=init), KEY)
+        vm.issue_tx(t)
+        blk = vm.build_block()
+        blk.verify()
+        blk.accept()
+        vm.blockchain.drain_acceptor_queue()
+        contract = rpc(server, "eth_getTransactionReceipt",
+                       "0x" + t.hash().hex())["contractAddress"]
+        t2 = signer.sign(Transaction(type=2, chain_id=43112,
+                                     nonce=nonce + 1, max_fee=10**12,
+                                     max_priority_fee=10**9, gas=100_000,
+                                     to=bytes.fromhex(contract[2:]),
+                                     value=0), KEY)
+        vm.issue_tx(t2)
+        blk2 = vm.build_block()
+        blk2.verify()
+        blk2.accept()
+        vm.blockchain.drain_acceptor_queue()
+        before = rpc(server, "debug_storageRangeAt",
+                     "0x" + blk2.id().hex(), 0, contract, "0x", 10)
+        assert len(before["storage"]) == 1
+        after = rpc(server, "debug_storageRangeAt",
+                    "0x" + blk2.id().hex(), 1, contract, "0x", 10)
+        assert after == {"storage": {}, "nextKey": None}
+
     def test_modified_accounts(self, live_vm):
         from coreth_tpu.native import keccak256
 

@@ -182,6 +182,63 @@ def test_wedge_mid_export_chain_continues(tmp_path):
     chain2.stop()
 
 
+def test_random_fork_lifecycle_with_midstream_wedge():
+    """The reorg fuzz (test_resident_chain.TestResidentReorgFuzz) with a
+    device wedge injected at a RANDOM round: the takeover must land in
+    the middle of sibling competition and every later fork/accept/
+    reject round must still match the default chain exactly."""
+    import random as _random
+
+    from coreth_tpu import params
+    from coreth_tpu.core.chain_makers import generate_chain
+
+    from test_resident_chain import KEY1, transfer_tx
+
+    for seed in (7, 21):
+        rng = _random.Random(seed)
+        resident = make_chain(commit_interval=3)
+        default = make_chain(resident=False)
+        w = arm(resident)
+        wedge_round = rng.randrange(1, 5)
+        base = params.APRICOT_PHASE3_INITIAL_BASE_FEE
+        nonces = {ADDR1: 0}
+
+        def fork(chain, parent, value):
+            def gen(i, bg):
+                bg.add_tx(transfer_tx(nonces[ADDR1], ADDR2, KEY1,
+                                      bg.base_fee() or base, value=value))
+
+            blocks, _ = generate_chain(chain.config, parent, chain.engine,
+                                       chain.state_database, 1, gen=gen)
+            return blocks[0]
+
+        for rnd in range(6):
+            if rnd == wedge_round:
+                w.wedge_run = True  # device dies between rounds
+            parent_d = default.last_accepted
+            assert resident.last_accepted.hash() == parent_d.hash()
+            blk_a = fork(default, parent_d, 100 + rnd)
+            blk_b = fork(default, parent_d, 200 + rnd)
+            for chain in (resident, default):
+                chain.insert_block_manual(blk_a, writes=True)
+                chain.insert_block_manual(blk_b, writes=True)
+            winner, loser = ((blk_a, blk_b) if rng.random() < 0.5
+                             else (blk_b, blk_a))
+            for chain in (resident, default):
+                chain.accept(winner)
+                chain.drain_acceptor_queue()
+                assert chain.acceptor_error is None, chain.acceptor_error
+                chain.reject(loser)
+            nonces[ADDR1] += 1
+            s_r, s_d = resident.state(), default.state()
+            for addr in (ADDR1, ADDR2):
+                assert s_r.get_balance(addr) == s_d.get_balance(addr), \
+                    (seed, rnd)
+        assert resident.mirror.host_mode, "wedge must have taken over"
+        resident.stop()
+        default.stop()
+
+
 def test_takeover_preserves_reorg_capability():
     """After the takeover the mirror's branch logic still works: verify a
     sibling block against an older parent (rewind+replay on the host)."""
