@@ -232,7 +232,9 @@ class DebugAPI:
 
     def _re_execute(self, blk, upto_index: Optional[int], tracer_factory):
         """Re-run the block's txs from the parent state; attach a fresh
-        tracer to each traced tx. Returns list of (tx, tracer, result)."""
+        tracer to each traced tx. Returns (results, state): results is a
+        list of (tx, tracer, receipt), state is the post-replay StateDB
+        (storageRangeAt reads it; trace callers drop it)."""
         chain = self.b.chain
         parent = chain.get_header(blk.parent_hash)
         if parent is None:

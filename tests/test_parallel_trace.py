@@ -76,7 +76,7 @@ def test_parallel_equals_sequential(traced_vm, tracer_cfg):
     api = DebugAPI(_Backend(vm))
     factory = api._tracer_factory(tracer_cfg)
 
-    seq = api._re_execute(blk, None, factory)
+    seq, _state = api._re_execute(blk, None, factory)
     par = api._re_execute_parallel(blk, factory, workers=4)
     assert len(seq) == len(par) == N_TXS
     for (tx_s, tr_s, rc_s), (tx_p, tr_p, rc_p) in zip(seq, par):
