@@ -342,6 +342,20 @@ class BlockChain:
         blk = self.get_block(block_hash)
         return blk.header if blk is not None else None
 
+    def get_header_by_number(self, number: int) -> Optional[Header]:
+        """Header-only canonical lookup: decodes just the header RLP, no
+        body/transactions (GetHeaderByNumber, eth/api.go:469 use) —
+        range scans like debug_getAccessibleState must not pay a full
+        block decode per candidate."""
+        h = self.get_canonical_hash(number)
+        if h is None:
+            return None
+        blk = self._blocks.get(h)
+        if blk is not None:
+            return blk.header
+        blob = rawdb.read_header_rlp(self.diskdb, number, h)
+        return Header.decode(blob) if blob is not None else None
+
     def get_receipts(self, block_hash: bytes) -> Optional[List[Receipt]]:
         cached = self._receipts.get(block_hash)
         if cached is not None:

@@ -487,6 +487,42 @@ class DebugAPI:
             e, s = s, chain.get_block(s.parent_hash)
         return self._modified_accounts(s, e)
 
+    def getAccessibleState(self, from_height: int, to_height: int) -> str:
+        """debug_getAccessibleState (eth/api.go GetAccessibleState,
+        coreth-only): the first block number scanning from `from`
+        TOWARD `to` (exclusive, reference loop semantics) whose state
+        is resolvable — under pruning most historical roots are gone,
+        and operators use this to find a re-executable anchor.
+        Negative numbers resolve to the current head (rpc.BlockNumber
+        latest/pending tags)."""
+        chain = self.b.chain
+        head = chain.last_accepted.number
+
+        def resolve(v: int) -> int:
+            v = int(v)
+            return head if v < 0 else v
+
+        lo, hi = resolve(from_height), resolve(to_height)
+        if lo == hi:
+            raise RPCError(-32000, "from and to needs to be different")
+        step = 1 if hi > lo else -1
+        for n in range(lo, hi, step):  # `to` exclusive, like the reference
+            header = chain.get_header_by_number(n)
+            if header is not None and chain.has_state(header.root):
+                return hx(n)
+        raise RPCError(-32000,
+                       f"no accessible state in [{lo}, {hi})")
+
+    def preimage(self, hash_: str) -> str:
+        """debug_preimage (eth/api.go Preimage): hashed-key preimages.
+        The repo's tries do not persist preimages (the reference also
+        requires --cache.preimages), so this reports the capability gap
+        explicitly instead of returning wrong data."""
+        raise RPCError(
+            -32000,
+            "preimage recording is not enabled (preimages are not "
+            "persisted; derive account keys via eth_getProof instead)")
+
     def getBadBlocks(self) -> list:
         """debug_getBadBlocks (eth/api.go GetBadBlocks): blocks that
         recently FAILED insertion (bad root, gas mismatch, ...)."""

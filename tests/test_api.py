@@ -493,6 +493,26 @@ class TestEthParitySweep:
                       "0x" + b2.id().hex())
         assert "0x" + keccak256(ADDR).hex() in by_hash
 
+    def test_accessible_state_and_preimage(self, live_vm):
+        vm, server, _, _ = live_vm
+        head = vm.blockchain.last_accepted.number
+        # every block's state is live on this short chain
+        assert int(rpc(server, "debug_getAccessibleState", 0, head),
+                   16) == 0
+        # reverse search finds the head first
+        assert int(rpc(server, "debug_getAccessibleState", head, 0),
+                   16) == head
+        # negative numbers resolve to the head (latest/pending tags)
+        assert int(rpc(server, "debug_getAccessibleState", -1, 0),
+                   16) == head
+        # reference semantics: from == to is an error, `to` is exclusive
+        with pytest.raises(RuntimeError, match="different"):
+            rpc(server, "debug_getAccessibleState", head, head)
+        with pytest.raises(RuntimeError, match="no accessible state"):
+            rpc(server, "debug_getAccessibleState", head + 50, head + 60)
+        with pytest.raises(RuntimeError, match="preimage recording"):
+            rpc(server, "debug_preimage", "0x" + "00" * 32)
+
     def test_bad_blocks_recorded(self, live_vm):
         from coreth_tpu.core.types import Block
 
