@@ -233,9 +233,11 @@ def main():
         n_big = n_small
         REPORT["mode"] = "early"
     repeats = int(os.environ.get("CORETH_TPU_BENCH_REPEATS", "3"))
-    cpu_threads = int(os.environ.get("CORETH_TPU_BENCH_CPU_THREADS", "0")) or (
-        os.cpu_count() or 1
-    )
+    from coreth_tpu.native import default_cpu_threads
+
+    cpu_threads = int(
+        os.environ.get("CORETH_TPU_BENCH_CPU_THREADS", "0")
+    ) or default_cpu_threads()
     kernel_env = os.environ.get("CORETH_TPU_BENCH_KERNEL", "")  # "", xla, pallas
 
     # ------------------------------------------------ host-only phase first
@@ -273,6 +275,15 @@ def main():
     big = workloads["big"]
     REPORT["cpu_nodes_per_sec"] = REPORT["big_cpu_nodes_per_sec"]
     REPORT["cpu_threads"] = cpu_threads
+    if cpu_threads > 1:
+        # single-thread oracle leg: the threaded/1T ratio is the native
+        # worker-pool win, with the root re-asserted against the same plan
+        k, v, o = big["arrays"]
+        cpu1_s, cpu1_root = best_of(
+            lambda: plan_commit(k, v, o).execute_cpu(threads=1), repeats)
+        assert cpu1_root == big["cpu_root"], "threaded root mismatch vs 1T"
+        REPORT["cpu_1t_nodes_per_sec"] = round(big["nodes"] / cpu1_s, 1)
+        REPORT["cpu_mt_speedup"] = round(cpu1_s / big["cpu_s"], 3)
 
     # ------------------------------------------------- device probes (subproc)
     ok, msg = probe_subprocess(PROBE_BACKEND, timeout=float(

@@ -119,6 +119,8 @@ def _block_insert_rate(resident: bool = False):
         # default path twice and report a bogus ~1.0 "parity"
         chain.stop()
         raise RuntimeError("resident mode unavailable (native planner)")
+    _LAST_INSERT_INFO["host_mode"] = (
+        chain.mirror.host_mode if chain.mirror is not None else None)
 
     # gas limits cap a block well under 1k transfers; the workload
     # spans ceil(n/per_block) full blocks (core/bench_test.go ring1000
@@ -154,6 +156,7 @@ def _block_insert_rate(resident: bool = False):
 
 
 _DEFAULT_INSERT_RATE = None  # bench_3 result, reused by bench_10
+_LAST_INSERT_INFO: dict = {}  # mirror mode of the last _block_insert_rate
 
 
 def bench_3():
@@ -464,19 +467,57 @@ def bench_9():
         print(json.dumps({"config": 9, **out}), flush=True)
 
 
+_RESIDENT_PHASES = (
+    "resident/phase/commit", "resident/phase/plan", "resident/phase/export",
+    "resident/phase/scatter", "resident/phase/patch", "resident/phase/store",
+    "resident/phase/host_hash",
+)
+_PLAN_CACHE = ("resident/plan_cache/hits", "resident/plan_cache/misses")
+
+
+def _phase_snapshot():
+    from coreth_tpu.metrics import default_registry
+
+    snap = {p: default_registry.timer(p).total() for p in _RESIDENT_PHASES}
+    snap.update({c: default_registry.counter(c).count() for c in _PLAN_CACHE})
+    return snap
+
+
+def _phase_delta(before):
+    after = _phase_snapshot()
+    out = {}
+    for p in _RESIDENT_PHASES:
+        d = after[p] - before[p]
+        if d > 0:
+            out[p.rsplit("/", 1)[1] + "_s"] = round(d, 4)
+    for c in _PLAN_CACHE:
+        d = after[c] - before[c]
+        if d > 0:
+            out["plan_cache_" + c.rsplit("/", 1)[1]] = int(d)
+    return out
+
+
 def bench_10():
     """Chain-level resident-mode insert throughput vs the default path —
     the end-to-end evidence for the resident chain integration (same
     workload as config 3; vs_baseline = resident / default). Reuses
     bench_3's default-leg measurement when it already ran this process
     (a whole-suite run would otherwise pay the 1k pure-Python signings
-    a third time)."""
+    a third time). Each leg carries its per-phase attribution (the
+    resident/phase/* timers) so a regression names the phase that ate
+    the time instead of just the headline tx/s."""
+    from coreth_tpu.native import default_cpu_threads
+
     try:
         # cold pass seeds the per-segment-shape jit compiles (persisted by
         # the compilation cache; a node restart reuses them) — the warm
         # pass is the steady-state number. Both are reported.
+        snap = _phase_snapshot()
         _, cold_rate = _block_insert_rate(resident=True)
+        cold_phases = _phase_delta(snap)
+        snap = _phase_snapshot()
         n_txs, res_rate = _block_insert_rate(resident=True)
+        warm_phases = _phase_delta(snap)
     except RuntimeError as e:
         print(json.dumps({"config": 10, "skipped": str(e)}), flush=True)
         return
@@ -488,6 +529,11 @@ def bench_10():
     print(json.dumps({
         "config": 10,
         "cold_txs_per_sec": round(cold_rate, 1),
+        "warm_txs_per_sec": round(res_rate, 1),
+        "cpu_threads": default_cpu_threads(),
+        "host_mode": _LAST_INSERT_INFO.get("host_mode"),
+        "phases_cold": cold_phases,
+        "phases_warm": warm_phases,
         "note": "cold = first-ever run compiling per-segment-shape device "
                 "programs (persisted; restarts reuse them)",
     }), flush=True)

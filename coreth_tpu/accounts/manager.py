@@ -56,7 +56,12 @@ class Manager:
                 for acct in self.external.accounts():
                     out.setdefault(acct.address, acct)
             except Exception:
-                pass  # daemon down: keystore accounts still serve
+                # daemon down: keystore accounts still serve, but the
+                # silent degradation must be countable (clef operators
+                # otherwise discover it from missing accounts)
+                from ..metrics import count_drop
+
+                count_drop("accounts/external/list_error")
         return sorted(out.values(), key=lambda a: a.address)
 
     def find(self, address: bytes) -> Optional[Account]:

@@ -60,6 +60,14 @@ class CacheConfig:
     # commits — trie/resident_mirror.py _take_over_host) and the chain
     # continues without stalling. None disables the watchdog.
     resident_commit_timeout: "float | None" = None
+    # resident mirror host preference: "auto" commits on the threaded
+    # native CPU hasher whenever no TPU backend resolves (the XLA-CPU
+    # keccak is no device at all — ~150x slower than native); True
+    # forces host commits, False pins the device path even on CPU
+    resident_prefer_host: "bool | str" = "auto"
+    # native CPU hasher worker threads; 0 = auto
+    # (env CORETH_TPU_CPU_THREADS, else min(16, cores))
+    cpu_threads: int = 0
     # bloom-bit index section (bloom_indexer.go BloomBitsBlocks)
     bloom_section_size: int = 4096
 
@@ -393,10 +401,13 @@ class BlockChain:
 
         tr = self.state_database.triedb.open_state_trie(
             self.last_accepted.root).trie
+        prefer = self.cache_config.resident_prefer_host
         self.mirror = ResidentAccountMirror(
             list(iterate_leaves(tr)),
             base_key=self.last_accepted.hash(),
             device_timeout=self.cache_config.resident_commit_timeout,
+            cpu_threads=self.cache_config.cpu_threads,
+            prefer_host=None if prefer == "auto" else bool(prefer),
         )
         self.state_database.mirror = self.mirror
         self.trie_writer = ResidentTrieWriter(
@@ -463,6 +474,15 @@ class BlockChain:
         try:
             self._insert_block(block, writes)
         except Exception as e:
+            # dedup by hash: consensus retries re-submit the same bad
+            # block, and each retry would otherwise evict a DISTINCT
+            # earlier failure from the bounded ring (the newest reason
+            # wins — it reflects the current chain state)
+            h = block.hash()
+            for i, (b, _) in enumerate(self.bad_blocks):
+                if b.hash() == h:
+                    del self.bad_blocks[i]
+                    break
             self.bad_blocks.append((block, f"{type(e).__name__}: {e}"))
             raise
 

@@ -183,16 +183,20 @@ class ResidentTrieWriter(TrieWriter):
             from ..log import get_logger
             from ..metrics import default_registry
 
-            default_registry.counter("state/resident/accept_misses").inc(1)
             if block.hash() in self._capped_inflight:
                 # post-fallback block: its account nodes live in the
                 # forest, so the capped policy (interval commit + tip
-                # buffer) carries durability from here
+                # buffer) carries durability from here. NOT a miss — the
+                # delegate accepts it by design, so it gets its own
+                # counter (an accept_misses alert must mean real misses)
+                default_registry.counter(
+                    "state/resident/detached_accepts").inc(1)
                 self._capped_inflight.discard(block.hash())
                 self._capped_writer().accept_trie(block)
                 return
             # blocks the mirror never saw and no detach: boot-recovery
             # replays through the default path (benign)
+            default_registry.counter("state/resident/accept_misses").inc(1)
             get_logger("state").warning(
                 "resident accept miss for block %d (%s) — interval export "
                 "skipped", block.number, e)

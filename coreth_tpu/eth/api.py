@@ -426,7 +426,11 @@ class EthAPI:
             try:
                 mine |= set(ext.accounts())
             except Exception:
-                pass
+                # daemon down: the keystore set still filters, but the
+                # degradation is countable (same signal as the manager)
+                from ..metrics import count_drop
+
+                count_drop("accounts/external/list_error")
         out = []
         for addr, txs in self.b.txpool.pending_txs().items():
             if addr in mine:

@@ -409,6 +409,10 @@ class DebugAPI:
         if parent is None:
             raise RPCError(-32000, "parent block not found")
         n = max(0, int(tx_index))
+        if n > len(blk.transactions):
+            # eth/api.go StorageRangeAt via stateAtTransaction: an index
+            # past the block's txs is a caller error, not "replay them all"
+            raise RPCError(-32000, "transaction index out of range")
         if n == 0:
             state = chain.state_at(parent.root)
         else:

@@ -106,10 +106,12 @@ class Timer:
     def __init__(self):
         self.hist = Histogram()
         self.meter = Meter()
+        self._total = 0.0
 
     def update(self, seconds: float) -> None:
         self.hist.update(seconds)
         self.meter.mark()
+        self._total += seconds
 
     def time(self):
         timer = self
@@ -129,6 +131,12 @@ class Timer:
 
     def mean(self) -> float:
         return self.hist.mean()
+
+    def total(self) -> float:
+        """Exact cumulative seconds across every update (unlike
+        mean()*count(), which drifts once the reservoir saturates) —
+        what the bench phase-attribution report divides."""
+        return self._total
 
 
 class Registry:
@@ -222,6 +230,17 @@ class _NullCtx:
 
 
 _NULL_CTX = _NullCtx()
+
+
+def phase_timer(name: str, registry: Optional[Registry] = None):
+    """Always-on phase-attribution timer for the commit pipeline
+    (plan / export / scatter / patch / store decomposition). Unlike
+    expensive_timer this is NOT gated: it fires a handful of times per
+    block commit, and the regression it guards (the resident-path CPU
+    overhead) must decompose mechanically in every bench run."""
+    if not enabled:
+        return _NULL_CTX
+    return (registry or default_registry).timer(name).time()
 
 
 def expensive_timer(name: str, registry: Optional[Registry] = None):

@@ -24,6 +24,23 @@ _lib = None
 _load_failed = False
 
 
+def default_cpu_threads() -> int:
+    """Worker fan-out for the native commit pipeline: the
+    CORETH_TPU_CPU_THREADS env override, else min(16, cpu_count) — the
+    reference's 16-goroutine cap (trie/hasher.go:124-139). One policy
+    shared by the vm config default (cpu_threads=0 -> this), the
+    resident mirror's host commits, and mpt_pool.h's C-side default."""
+    raw = os.environ.get("CORETH_TPU_CPU_THREADS", "")
+    if raw:
+        try:
+            v = int(raw)
+            if v > 0:
+                return v
+        except ValueError:
+            pass
+    return min(16, os.cpu_count() or 1)
+
+
 def load():
     """Return the ctypes lib, building it if needed, or None on failure."""
     global _lib, _load_failed

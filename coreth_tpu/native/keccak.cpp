@@ -15,6 +15,8 @@
 #include <vector>
 #include <algorithm>
 
+#include "mpt_pool.h"
+
 namespace {
 
 constexpr int kRate = 136;
@@ -108,16 +110,17 @@ void keccak256_batch_mt(const uint8_t* data, const uint64_t* offsets, uint64_t n
     keccak256_batch(data, offsets, n, out);
     return;
   }
-  threads = std::min<int>(threads, std::thread::hardware_concurrency());
-  std::vector<std::thread> pool;
-  pool.reserve(threads);
-  for (int t = 0; t < threads; ++t) {
-    pool.emplace_back([=] {
-      for (uint64_t i = t; i < n; i += threads)
-        keccak256_one(data + offsets[i], offsets[i + 1] - offsets[i], out + 32 * i);
-    });
-  }
-  for (auto& th : pool) th.join();
+  // pooled fan-out (mpt_pool.h): parked workers instead of per-batch
+  // thread spawns — the spawn cost used to dominate below ~1k messages
+  mptp::parallel(threads, [&](int t, int nt) {
+    for (uint64_t i = (uint64_t)t; i < n; i += (uint64_t)nt)
+      keccak256_one(data + offsets[i], offsets[i + 1] - offsets[i], out + 32 * i);
+  });
 }
+
+// Default worker fan-out for the batched/threaded entry points:
+// CORETH_TPU_CPU_THREADS override, else min(16, hardware_concurrency)
+// — exported so the Python side and the C side agree on one policy.
+int keccak_default_threads() { return mptp::default_threads(); }
 
 }  // extern "C"

@@ -35,6 +35,7 @@
 #include <algorithm>
 
 #include "mpt_common.h"
+#include "mpt_pool.h"
 
 namespace {
 
@@ -556,16 +557,17 @@ void layout(Plan& p) {
       }
     };
 
-    if (hw > 1 && real >= 2048) {
+    if (hw > 1 && real >= 512) {
+      // pooled fan-out (mpt_pool.h): parked workers make the per-level
+      // dispatch a condvar wake, so levels far below the old 2048-lane
+      // spawn threshold are now worth threading
       int t = std::min(hw, 16);
-      int chunk = (real + t - 1) / t;
       std::vector<std::vector<std::array<int32_t, 3>>> locals(t);
-      std::vector<std::thread> pool;
-      for (int i = 0; i < t; ++i)
-        pool.emplace_back(write_range, i * chunk,
-                          std::min(real, (i + 1) * chunk),
-                          std::ref(locals[i]));
-      for (auto& th : pool) th.join();
+      mptp::parallel(t, [&](int i, int nt) {
+        int chunk = (real + nt - 1) / nt;
+        write_range(i * chunk, std::min(real, (i + 1) * chunk),
+                    locals[i]);
+      });
       for (auto& lp : locals)
         for (auto& e : lp) {
           seg.pl.push_back(e[0]);
@@ -731,16 +733,13 @@ void mpt_plan_execute_cpu(void* h, int threads, uint8_t* digests_out,
                       seg.blocks, dig + ((int64_t)seg.gstart + lane) * 32);
       }
     };
-    if (threads > 1 && real >= 256) {
-      // hardware_concurrency() may return 0 (unknown) — clamp to >= 1
-      int hw = std::max(1u, std::thread::hardware_concurrency());
-      int t = std::min(threads, hw);
-      std::vector<std::thread> pool;
-      int chunk = (real + t - 1) / t;
-      for (int i = 0; i < t; ++i)
-        pool.emplace_back(hash_range, i * chunk,
-                          std::min(real, (i + 1) * chunk));
-      for (auto& th : pool) th.join();
+    if (threads > 1 && real >= 64) {
+      // pooled fan-out: the parked-worker dispatch (~us) makes small
+      // levels worth threading (the old spawn-per-call floor was 256)
+      mptp::parallel(threads, [&](int i, int nt) {
+        int chunk = (real + nt - 1) / nt;
+        hash_range(i * chunk, std::min(real, (i + 1) * chunk));
+      });
     } else {
       hash_range(0, real);
     }

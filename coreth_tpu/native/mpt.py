@@ -21,6 +21,9 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import default_cpu_threads  # noqa: F401  (re-export: one policy)
+from ..metrics import phase_timer
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "mpt.cpp")
 _LIB = os.path.join(_DIR, "libmpt.so")
@@ -503,10 +506,13 @@ class IncrementalTrie:
     def commit_cpu(self, threads: int = 1) -> bytes:
         """Incremental host commit; returns the 32-byte root."""
         self._pin_mode("host")
-        if self._lib.mpt_inc_plan(self._h) == 0:
+        with phase_timer("resident/phase/plan"):
+            n_seg = self._lib.mpt_inc_plan(self._h)
+        if n_seg == 0:
             return self.root()
         out = np.empty(32, np.uint8)
-        self._lib.mpt_inc_execute_cpu(self._h, threads, out)
+        with phase_timer("resident/phase/host_hash"):
+            self._lib.mpt_inc_execute_cpu(self._h, threads, out)
         return out.tobytes()
 
     def commit_device(self, planned=None) -> bytes:
@@ -552,7 +558,8 @@ class IncrementalTrie:
         the upload payload (ops/keccak_resident.py's input format).
         Returns None when nothing is dirty."""
         lib, h = self._lib, self._h
-        n_seg = int(lib.mpt_inc_plan_res(h))
+        with phase_timer("resident/phase/plan"):
+            n_seg = int(lib.mpt_inc_plan_res(h))
         if n_seg == (1 << 64) - 1:
             raise ValueError("node RLP wider than the resident row limit")
         if n_seg == (1 << 64) - 2:
@@ -561,35 +568,37 @@ class IncrementalTrie:
                 "range (checked before any allocation)")
         if n_seg == 0:
             return None
-        meta = np.empty(7, np.int64)
-        lib.mpt_inc_res_meta(h, meta)
-        total_lanes, total_patches = int(meta[0]), int(meta[1])
-        specs = np.empty((n_seg, 6), np.int32)
-        lib.mpt_inc_res_specs(h, specs.reshape(-1))
-        n_cls = int(meta[6])
-        cls_counts = np.empty((n_cls, 2), np.int32)
-        lib.mpt_inc_res_cls_counts(h, cls_counts.reshape(-1))
-        rowidx = np.empty(total_lanes, np.int32)
-        lane_slot = np.empty(total_lanes, np.int32)
-        off = np.empty(total_patches, np.int32)
-        src = np.empty(total_patches, np.int32)
-        oldidx = np.empty(total_patches, np.int32)
-        lib.mpt_inc_res_tables(h, rowidx, lane_slot, off, src, oldidx)
-        fresh = {}
-        classes = {}
-        for cls in range(1, n_cls):
-            n_fresh, rows_needed = int(cls_counts[cls, 0]), int(
-                cls_counts[cls, 1])
-            if rows_needed > 1:
-                classes[cls] = (n_fresh, rows_needed)
-            if n_fresh == 0:
-                continue
-            width = cls * 136
-            rows = np.empty(n_fresh * width, np.uint8)
-            idx = np.empty(n_fresh, np.int32)
-            lib.mpt_inc_res_fresh(h, cls, rows, idx)
-            fresh[cls] = (rows.view(np.uint32).reshape(n_fresh, width // 4),
-                          idx)
+        with phase_timer("resident/phase/export"):
+            meta = np.empty(7, np.int64)
+            lib.mpt_inc_res_meta(h, meta)
+            total_lanes, total_patches = int(meta[0]), int(meta[1])
+            specs = np.empty((n_seg, 6), np.int32)
+            lib.mpt_inc_res_specs(h, specs.reshape(-1))
+            n_cls = int(meta[6])
+            cls_counts = np.empty((n_cls, 2), np.int32)
+            lib.mpt_inc_res_cls_counts(h, cls_counts.reshape(-1))
+            rowidx = np.empty(total_lanes, np.int32)
+            lane_slot = np.empty(total_lanes, np.int32)
+            off = np.empty(total_patches, np.int32)
+            src = np.empty(total_patches, np.int32)
+            oldidx = np.empty(total_patches, np.int32)
+            lib.mpt_inc_res_tables(h, rowidx, lane_slot, off, src, oldidx)
+            fresh = {}
+            classes = {}
+            for cls in range(1, n_cls):
+                n_fresh, rows_needed = int(cls_counts[cls, 0]), int(
+                    cls_counts[cls, 1])
+                if rows_needed > 1:
+                    classes[cls] = (n_fresh, rows_needed)
+                if n_fresh == 0:
+                    continue
+                width = cls * 136
+                rows = np.empty(n_fresh * width, np.uint8)
+                idx = np.empty(n_fresh, np.int32)
+                lib.mpt_inc_res_fresh(h, cls, rows, idx)
+                fresh[cls] = (rows.view(np.uint32).reshape(n_fresh,
+                                                           width // 4),
+                              idx)
         return {
             "specs": specs,
             "classes": classes,

@@ -34,6 +34,7 @@
 #include <algorithm>
 
 #include "mpt_common.h"
+#include "mpt_pool.h"
 
 namespace {
 
@@ -1102,14 +1103,15 @@ void mpt_inc_execute_cpu(void* h, int threads, uint8_t* out_root32) {
         keccak_padded(t->flat.get() + seg.byte_base + (int64_t)lane * width,
                       seg.blocks, dig.data() + ((int64_t)seg.gstart + lane) * 32);
     };
-    if (threads > 1 && real >= 256) {
-      int hw = std::max(1u, std::thread::hardware_concurrency());
-      int tn = std::min(threads, hw);
-      std::vector<std::thread> pool;
-      int chunk = (real + tn - 1) / tn;
-      for (int i = 0; i < tn; ++i)
-        pool.emplace_back(hash_range, i * chunk, std::min(real, (i + 1) * chunk));
-      for (auto& th : pool) th.join();
+    if (threads > 1 && real >= 64) {
+      // pooled level fan-out (mpt_pool.h): the resident mini-plan's
+      // segments ARE dirty-height levels, so this is the reference's
+      // 16-goroutine per-level hash (trie/hasher.go:124-139) with
+      // parked workers instead of per-level thread spawns
+      mptp::parallel(threads, [&](int i, int nt) {
+        int chunk = (real + nt - 1) / nt;
+        hash_range(i * chunk, std::min(real, (i + 1) * chunk));
+      });
     } else {
       hash_range(0, real);
     }

@@ -195,28 +195,34 @@ class PlannedCommit:
             want_digests: bool = False) -> Tuple[bytes, Optional[np.ndarray]]:
         """Inputs from CommitPlan.export_words(). Returns (root32,
         dig uint32[G, 8] | None)."""
+        from ..metrics import phase_timer
+
         n_seg = len(specs)
         if n_seg > MAX_SEGMENTS:
             raise ValueError(f"{n_seg} segments > MAX_SEGMENTS={MAX_SEGMENTS}")
         total_lanes = sum(s.lanes for s in specs)
 
         if self.fused:
-            aux = np.concatenate([
-                dst_word.astype(np.int32),
-                (child_lane + 1).astype(np.int32),
-                shift.astype(np.int32),
-            ]) if len(dst_word) else np.zeros(0, np.int32)
-            fw = jax.device_put(flat_words)
-            ax = jax.device_put(aux)
+            with phase_timer("planned/phase/scatter"):
+                aux = np.concatenate([
+                    dst_word.astype(np.int32),
+                    (child_lane + 1).astype(np.int32),
+                    shift.astype(np.int32),
+                ]) if len(dst_word) else np.zeros(0, np.int32)
+                fw = jax.device_put(flat_words)
+                ax = jax.device_put(aux)
             self.last_h2d_bytes = flat_words.nbytes + aux.nbytes
             self.last_transfers = 2
             self.last_dispatches = 1
-            dig = self._fused(tuple(specs))(fw, ax)
-            if want_digests:
-                host = np.asarray(dig)
-                return host[root_pos + 1].astype("<u4").tobytes(), host[1:]
-            root = np.asarray(dig[root_pos + 1])
-            return root.astype("<u4").tobytes(), None
+            with phase_timer("planned/phase/patch"):
+                dig = self._fused(tuple(specs))(fw, ax)
+            with phase_timer("planned/phase/store"):
+                if want_digests:
+                    host = np.asarray(dig)
+                    return (host[root_pos + 1].astype("<u4").tobytes(),
+                            host[1:])
+                root = np.asarray(dig[root_pos + 1])
+                return root.astype("<u4").tobytes(), None
 
         meta = np.zeros((MAX_SEGMENTS, 3), np.int32)
         word_off = 0
@@ -226,32 +232,36 @@ class PlannedCommit:
             word_off += s.lanes * s.blocks * WORDS_PER_BLOCK
             patch_off += s.n_patches
 
-        # the whole commit's h2d: one bulk word stream + patch tables + meta
-        fw = jax.device_put(flat_words)
-        # +1: sentinel zero row that pad patches (child_lane == -1) gather
-        ch = jax.device_put((child_lane + 1).astype(np.int32))
-        dw = jax.device_put(dst_word)
-        sh = jax.device_put(shift)
-        mt = jax.device_put(meta)
-        # per-step segment ids sliced on device (no per-step h2d, and the
-        # step programs stay shape-keyed only)
-        seg_ids = jax.device_put(np.arange(MAX_SEGMENTS, dtype=np.int32))
+        with phase_timer("planned/phase/scatter"):
+            # whole commit's h2d: one bulk word stream + patch tables + meta
+            fw = jax.device_put(flat_words)
+            # +1: sentinel zero row that pad patches (child_lane == -1)
+            # gather
+            ch = jax.device_put((child_lane + 1).astype(np.int32))
+            dw = jax.device_put(dst_word)
+            sh = jax.device_put(shift)
+            mt = jax.device_put(meta)
+            # per-step segment ids sliced on device (no per-step h2d, and
+            # the step programs stay shape-keyed only)
+            seg_ids = jax.device_put(np.arange(MAX_SEGMENTS, dtype=np.int32))
         dig = jnp.zeros((1 + total_lanes, 8), jnp.uint32)
         self.last_h2d_bytes = (flat_words.nbytes + child_lane.nbytes
                                + dst_word.nbytes + shift.nbytes + meta.nbytes)
         self.last_transfers = 6
         self.last_dispatches = n_seg
 
-        for i, s in enumerate(specs):
-            fw, dig = self._step(
-                fw, dig, dw, ch, sh, mt, seg_ids[i],
-                lanes=s.lanes, blocks=s.blocks, npatch=s.n_patches,
-            )
-        if want_digests:
-            host = np.asarray(dig)
-            return host[root_pos + 1].astype("<u4").tobytes(), host[1:]
-        root = np.asarray(dig[root_pos + 1])
-        return root.astype("<u4").tobytes(), None
+        with phase_timer("planned/phase/patch"):
+            for i, s in enumerate(specs):
+                fw, dig = self._step(
+                    fw, dig, dw, ch, sh, mt, seg_ids[i],
+                    lanes=s.lanes, blocks=s.blocks, npatch=s.n_patches,
+                )
+        with phase_timer("planned/phase/store"):
+            if want_digests:
+                host = np.asarray(dig)
+                return host[root_pos + 1].astype("<u4").tobytes(), host[1:]
+            root = np.asarray(dig[root_pos + 1])
+            return root.astype("<u4").tobytes(), None
 
 
 _default_commit: Optional[PlannedCommit] = None

@@ -26,13 +26,31 @@ from __future__ import annotations
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from .. import rlp
+from ..native import default_cpu_threads  # noqa: F401  (re-export: one policy)
 from ..native import keccak256 as _cpu_keccak
+from ..native import keccak256_batch as _cpu_keccak_batch
 from .encoding import hex_to_compact
 from .node import FullNode, HashNode, ShortNode, ValueNode
 
 # Below this many dirty nodes the CPU hasher wins (kernel launch + transfer
 # latency); mirrors the reference's >=100-unhashed parallel threshold.
 BATCH_THRESHOLD = 100
+
+
+def cpu_batch_keccak(threads: int = 0):
+    """Threaded-native batch keccak usable as new_hasher's batch_keccak seam.
+
+    The reference fans out 16 goroutines per branch when >=100 nodes are
+    unhashed (trie/hasher.go:124-139); this is the same lever on the native
+    C++ keccak — one call, the level's messages striped across a parked
+    worker pool. threads<=0 resolves to default_cpu_threads().
+    """
+    t = threads if threads > 0 else default_cpu_threads()
+
+    def batch(msgs: Sequence[bytes]) -> List[bytes]:
+        return _cpu_keccak_batch(msgs, threads=t)
+
+    return batch
 
 
 def node_items(n, child_repr: Callable = None):

@@ -339,7 +339,10 @@ class PlannedGraphBuilder:
 
         Raises _TooManySegments when the graph exceeds the executor's
         segment table; callers fall back to the level-batched hasher."""
-        built = self.build()
+        from ..metrics import phase_timer
+
+        with phase_timer("planned/phase/plan"):
+            built = self.build()
         if built is None:
             raise TooManySegments()
         specs, flat_words, dst, child, shift, root_pos, total_lanes = built
@@ -349,17 +352,18 @@ class PlannedGraphBuilder:
             planned = default_planned_commit()
         _root, dig = planned.run(specs, flat_words, dst, child, shift,
                                  root_pos, want_digests=True)
-        digs = np.ascontiguousarray(dig).view(np.uint8).reshape(-1, 32)
+        with phase_timer("planned/phase/absorb"):
+            digs = np.ascontiguousarray(dig).view(np.uint8).reshape(-1, 32)
 
-        for n, gid in self._hashed:
-            n.flags.hash = digs[gid].tobytes()
-            n.flags.dirty = True
-        for n, off, src in self._healed:
-            root_digest = digs[src.root_lane].tobytes()
-            vb = bytearray(bytes(n.val))
-            vb[off:off + 32] = root_digest
-            n.val = ValueNode(bytes(vb))
-        return digs[root_pos].tobytes()
+            for n, gid in self._hashed:
+                n.flags.hash = digs[gid].tobytes()
+                n.flags.dirty = True
+            for n, off, src in self._healed:
+                root_digest = digs[src.root_lane].tobytes()
+                vb = bytearray(bytes(n.val))
+                vb[off:off + 32] = root_digest
+                n.val = ValueNode(bytes(vb))
+            return digs[root_pos].tobytes()
 
     def digest(self, entry: _TrieEntry) -> bytes:
         return entry.root.flags.hash

@@ -66,12 +66,50 @@ class ResidentAccountMirror:
 
     def __init__(self, items: Sequence[Tuple[bytes, bytes]] = (),
                  executor=None, base_key: Optional[bytes] = None,
-                 device_timeout: Optional[float] = None):
-        if executor is None:
+                 device_timeout: Optional[float] = None,
+                 cpu_threads: Optional[int] = None,
+                 prefer_host: Optional[bool] = None):
+        import os
+
+        if cpu_threads is None or int(cpu_threads) <= 0:
+            from ..native import default_cpu_threads
+
+            cpu_threads = default_cpu_threads()
+        self._cpu_threads = int(cpu_threads)
+        # CPU fast path (VERDICT r5 #4, the config-10 regression): when
+        # no TPU backend resolves, the "device" a ResidentExecutor would
+        # dispatch to is XLA-CPU, whose keccak is ~150x slower than the
+        # native hasher — the resident chain path ran 5.6x behind the
+        # default path because of it. Unless the caller pinned the
+        # device path (an explicit executor, prefer_host=False, or the
+        # env override), start in host mode from construction: the
+        # mirror lifecycle (verify/accept/reject/reorg, exports, reads)
+        # and the roots are identical, but every commit runs the
+        # threaded native incremental hasher. This is also what makes a
+        # later device-wedge takeover a soft landing — takeover lands on
+        # exactly this path.
+        env = os.environ.get("CORETH_TPU_RESIDENT_HOST", "").lower()
+        if env in ("1", "true", "yes"):
+            prefer_host = True
+        elif env in ("0", "false", "no"):
+            prefer_host = False
+        if prefer_host is None:
+            if executor is not None:
+                prefer_host = False
+            else:
+                from ..ops.keccak_planned import _tpu_backend
+
+                prefer_host = not _tpu_backend()
+        self.host_mode = bool(prefer_host)
+        if self.host_mode:
+            from ..metrics import default_registry
+
+            default_registry.counter("state/resident/cpu_fastpath").inc(1)
+        elif executor is None:
             from ..ops.keccak_resident import ResidentExecutor
 
             executor = ResidentExecutor()
-        self.ex = executor
+        self.ex = executor  # None in host mode unless the caller passed one
         self._lock = threading.RLock()
         self.trie = IncrementalTrie(items)
         # device-failure takeover (VERDICT r4 #4): a commit the device
@@ -79,8 +117,6 @@ class ResidentAccountMirror:
         # one-way host takeover — full host rehash, then every later
         # commit/export runs commit_cpu. None = watchdog off (tests /
         # trusted local backends); env override for ops.
-        import os
-
         if device_timeout is None:
             raw = os.environ.get("CORETH_TPU_RESIDENT_TIMEOUT", "")
             try:
@@ -95,8 +131,6 @@ class ResidentAccountMirror:
         if device_timeout is not None and device_timeout <= 0:
             device_timeout = None  # 0 disables the watchdog (config doc)
         self.device_timeout = device_timeout
-        self.host_mode = False  # True after takeover: CPU-resident
-        self._cpu_threads = os.cpu_count() or 1
         base = base_key if base_key is not None else self.GENESIS
         # flags BEFORE the genesis commit: a takeover during it must not
         # have its degradation markers clobbered below
@@ -121,16 +155,18 @@ class ResidentAccountMirror:
         device path runs under the watchdog; a wedge triggers the
         takeover and the SAME commit completes on the CPU, so callers
         never see the failure (the chain does not stall)."""
+        from ..metrics import phase_timer
         from ..native.mpt import DeviceWedgedError
 
-        if self.host_mode:
-            return self.trie.commit_cpu(threads=self._cpu_threads)
-        try:
-            return self.trie.commit_resident_timed(
-                self.ex, self.device_timeout)
-        except DeviceWedgedError as e:
-            self._take_over_host(str(e))
-            return self.trie.commit_cpu(threads=self._cpu_threads)
+        with phase_timer("resident/phase/commit"):
+            if self.host_mode:
+                return self.trie.commit_cpu(threads=self._cpu_threads)
+            try:
+                return self.trie.commit_resident_timed(
+                    self.ex, self.device_timeout)
+            except DeviceWedgedError as e:
+                self._take_over_host(str(e))
+                return self.trie.commit_cpu(threads=self._cpu_threads)
 
     def _take_over_host(self, why: str) -> None:
         """One-way device -> host switch: rebuild the full host digest

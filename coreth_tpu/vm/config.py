@@ -68,6 +68,14 @@ class Config:
     # watchdog budget (s) per resident device commit; on expiry the
     # mirror takes over on the host and the chain continues (0 disables)
     resident_commit_timeout: float = 180.0
+    # resident mirror host preference: "auto" (default) runs commits on
+    # the threaded native CPU hasher whenever no TPU backend resolves
+    # (the XLA-CPU "device" keccak is ~150x slower than native); true
+    # forces host commits, false pins the device path even on CPU
+    resident_prefer_host: "bool | str" = "auto"
+    # native CPU hasher worker threads (plan execute + batch keccak);
+    # 0 = auto (env CORETH_TPU_CPU_THREADS, else min(16, cores))
+    cpu_threads: int = 0
 
     # --- tx pool ----------------------------------------------------------
     local_txs_enabled: bool = False
@@ -139,6 +147,13 @@ class Config:
             raise ValueError(
                 f"resident-account-trie must be true, false, or \"auto\" "
                 f"(got {self.resident_account_trie!r})")
+        if self.resident_prefer_host not in (True, False, "auto"):
+            raise ValueError(
+                f"resident-prefer-host must be true, false, or \"auto\" "
+                f"(got {self.resident_prefer_host!r})")
+        if self.cpu_threads < 0:
+            raise ValueError(
+                f"cpu-threads must be >= 0 (got {self.cpu_threads})")
         if self.resident_account_trie is True and not self.pruning_enabled:
             raise ValueError(
                 "resident-account-trie requires pruning: interval "

@@ -61,6 +61,11 @@ class VMConfig:
     resident_account_trie: "bool | str" = "auto"
     # watchdog (s) per resident device commit; expiry -> host takeover
     resident_commit_timeout: "float | None" = 180.0
+    # resident mirror host preference ("auto": host commits whenever no
+    # TPU backend resolves; True/False force)
+    resident_prefer_host: "bool | str" = "auto"
+    # native CPU hasher worker threads; 0 = auto
+    cpu_threads: int = 0
 
 
 @dataclass
@@ -110,6 +115,8 @@ class VM:
                 # "explicitly disabled" — collapsing it to None would
                 # re-open the env-var override the operator turned off
                 resident_commit_timeout=full.resident_commit_timeout,
+                resident_prefer_host=full.resident_prefer_host,
+                cpu_threads=full.cpu_threads,
             )
         else:
             from .config import Config as FullConfig
@@ -184,6 +191,8 @@ class VM:
                 device_hasher=self.config.device_hasher,
                 resident_account_trie=self.config.resident_account_trie,
                 resident_commit_timeout=self.config.resident_commit_timeout,
+                resident_prefer_host=self.config.resident_prefer_host,
+                cpu_threads=self.config.cpu_threads,
                 snapshot_limit=self.config.snapshot_limit,
                 trie_dirty_limit=full.trie_dirty_cache * 1024 * 1024,
                 accepted_cache_size=full.accepted_cache_size,

@@ -394,6 +394,22 @@ class TestEthParitySweep:
                     "0x", 10)
         assert after["nextKey"] is None
 
+    def test_storage_range_at_index_out_of_range(self, live_vm):
+        """tx_index past the block's txs is a caller error (-32000
+        'transaction index out of range'), NOT a silent full-block
+        replay — eth/api.go stateAtTransaction semantics."""
+        vm, server, _, (t2, b2) = live_vm
+        bh = "0x" + b2.id().hex()
+        emitter = "0x" + (b"\xee" * 20).hex()
+        n = len(b2.eth_block.transactions)
+        # index == len(txs) is the last valid prefix (state AFTER the
+        # whole block's txs)
+        rpc(server, "debug_storageRangeAt", bh, n, emitter, "0x", 10)
+        with pytest.raises(RuntimeError,
+                           match="transaction index out of range"):
+            rpc(server, "debug_storageRangeAt", bh, n + 1, emitter,
+                "0x", 10)
+
     def test_storage_range_at_committed_storage(self, live_vm):
         """The fallback path the empty-storage case can't exercise: an
         UNTOUCHED contract with real committed storage must serve its
@@ -527,6 +543,23 @@ class TestEthParitySweep:
         assert len(bads) == 1
         assert bads[0]["hash"] == "0x" + bad.hash().hex()
         assert bads[0]["reason"]
+
+    def test_bad_blocks_dedup_by_hash(self, live_vm):
+        """Re-submitting the SAME bad block (consensus retries) must not
+        evict distinct earlier failures from the 10-deep ring — the ring
+        dedups by hash, keeping one entry per bad block."""
+        from coreth_tpu.core.types import Block
+
+        vm, server, _, (t2, b2) = live_vm
+        bad = Block.decode(b2.eth_block.encode())
+        bad.header.root = b"\xad" * 32
+        for _ in range(3):
+            with pytest.raises(Exception):
+                vm.blockchain.insert_block(bad)
+        bads = rpc(server, "debug_getBadBlocks")
+        hashes = [b["hash"] for b in bads]
+        assert hashes.count("0x" + bad.hash().hex()) == 1
+        assert len(hashes) == len(set(hashes))
 
     def test_coinbase_and_admin_export_import(self, live_vm, tmp_path):
         from coreth_tpu.vm.api import AdminAPI

@@ -57,6 +57,35 @@ class TestNativePlanParity:
         plan = plan_from_items(items)
         assert plan.execute_cpu(threads=1) == plan.execute_cpu(threads=8)
 
+    @pytest.mark.parametrize("threads", [2, 5, 16])
+    def test_threaded_random_tries_bit_exact(self, threads):
+        """Worker-pool hashing across randomized trie shapes — sized to
+        straddle the parallel threshold both ways — must match the
+        single-thread oracle AND the Python trie bit-exactly. Thread
+        counts deliberately oversubscribe 1-core CI so the pooled path
+        (not the serial guard) is what runs."""
+        rng = random.Random(100 + threads)
+        for trial in range(4):
+            n = rng.choice([40, 300, 1200, 4000])
+            items = _random_items(n, 1, 120, rng.randrange(1 << 30))
+            r1 = plan_from_items(items).execute_cpu(threads=1)
+            assert plan_from_items(items).execute_cpu(
+                threads=threads) == r1, (threads, trial, n)
+            if n <= 300:  # keep the Python-trie oracle leg cheap
+                assert r1 == _trie_root(items)
+
+    def test_threaded_batch_keccak_matches_serial(self):
+        """keccak256_batch with a pooled thread count must equal the
+        serial path message-for-message (mixed sizes incl. multi-block
+        and empty messages)."""
+        from coreth_tpu.native import keccak256_batch
+
+        rng = random.Random(55)
+        msgs = [rng.randbytes(rng.choice([0, 1, 55, 136, 137, 500, 4000]))
+                for _ in range(300)]
+        assert keccak256_batch(msgs, threads=1) == \
+            keccak256_batch(msgs, threads=7)
+
     def test_device_root_matches_cpu(self):
         items = _random_items(1500, 1, 120, 11)
         plan = plan_from_items(items)

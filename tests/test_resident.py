@@ -363,3 +363,103 @@ def test_resident_lifecycle_fuzz():
                 _full_rebuild_root(state), f"fuzz step {step}"
     assert _root_bytes(ex, dev.commit_resident(ex)) == \
         _full_rebuild_root(state)
+
+
+def test_plan_cache_warm_commits_hit_and_stay_exact():
+    """Steady-state value-only churn repeats the same segment-shape
+    tuple: the first shaped commit compiles (plan_cache miss + staging
+    alloc), every later one must HIT — observable via the counters the
+    phase-attribution work added — with roots still bit-exact (the hit
+    path refills preallocated staging in place)."""
+    from coreth_tpu.metrics import default_registry
+
+    rng = random.Random(31)
+    state = _rand_items(rng, 800)
+    dev = IncrementalTrie(sorted(state.items()))
+    cpu = IncrementalTrie(sorted(state.items()))
+    ex = _executor()
+    assert _root_bytes(ex, dev.commit_resident(ex)) == cpu.commit_cpu()
+
+    hits = default_registry.counter("resident/plan_cache/hits")
+    chosen = rng.sample(list(state), 64)  # fixed key set -> fixed shape
+    h0 = hits.count()
+    for rnd in range(4):
+        batch = [(k, rng.randbytes(60)) for k in chosen]
+        dev.update(batch)
+        cpu.update(batch)
+        assert _root_bytes(ex, dev.commit_resident(ex)) == cpu.commit_cpu(), \
+            f"round {rnd} root mismatch"
+    # round 0 may miss (new shape); rounds 1..3 repeat it exactly
+    assert hits.count() - h0 >= 3
+    assert ex.last_cache_hit
+
+
+def test_plan_cache_shape_change_misses_then_recovers():
+    """A structural burst (fresh inserts) changes the segment-shape key:
+    the cache must MISS — no stale staging/compiled program may serve the
+    new shape — and the new shape then warms up like any other."""
+    from coreth_tpu.metrics import default_registry
+
+    rng = random.Random(32)
+    state = _rand_items(rng, 600)
+    dev = IncrementalTrie(sorted(state.items()))
+    cpu = IncrementalTrie(sorted(state.items()))
+    ex = _executor()
+    assert _root_bytes(ex, dev.commit_resident(ex)) == cpu.commit_cpu()
+
+    chosen = rng.sample(list(state), 32)
+    for _ in range(2):  # warm a value-only shape into the cache
+        batch = [(k, rng.randbytes(40)) for k in chosen]
+        dev.update(batch)
+        cpu.update(batch)
+        state.update(batch)
+        assert _root_bytes(ex, dev.commit_resident(ex)) == cpu.commit_cpu()
+    assert ex.last_cache_hit
+
+    misses = default_registry.counter("resident/plan_cache/misses")
+    m0 = misses.count()
+    burst = [(rng.randbytes(32), rng.randbytes(50)) for _ in range(300)]
+    dev.update(burst)
+    cpu.update(burst)
+    for k, v in burst:
+        state[k] = v
+    assert _root_bytes(ex, dev.commit_resident(ex)) == cpu.commit_cpu()
+    assert not ex.last_cache_hit, "structural shape change must miss"
+    assert misses.count() == m0 + 1
+    assert _root_bytes(ex, ex.last_root) == _full_rebuild_root(state)
+
+
+def test_threaded_commit_cpu_bit_exact_vs_single_thread():
+    """The pooled native hasher (explicitly oversubscribed — CI may have
+    one core) must be bit-exact vs the single-thread oracle across
+    randomized churn, including the full-rebuild planner as a third
+    opinion."""
+    rng = random.Random(33)
+    state = _rand_items(rng, 1500)
+    mt = IncrementalTrie(sorted(state.items()))
+    st = IncrementalTrie(sorted(state.items()))
+    assert mt.commit_cpu(threads=8) == st.commit_cpu(threads=1)
+
+    keys = list(state)
+    for rnd in range(5):
+        batch = []
+        for _ in range(200):
+            r = rng.random()
+            if r < 0.4:
+                batch.append((rng.choice(keys), rng.randbytes(60)))
+            elif r < 0.75:
+                k = rng.randbytes(32)
+                keys.append(k)
+                batch.append((k, rng.randbytes(45)))
+            else:
+                batch.append((rng.choice(keys), b""))
+        mt.update(batch)
+        st.update(batch)
+        for k, v in batch:
+            if v:
+                state[k] = v
+            else:
+                state.pop(k, None)
+        r_mt = mt.commit_cpu(threads=8)
+        assert r_mt == st.commit_cpu(threads=1), f"round {rnd} mismatch"
+        assert r_mt == _full_rebuild_root(state), f"round {rnd} vs rebuild"

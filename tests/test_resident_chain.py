@@ -35,7 +35,11 @@ ADDR2 = priv_to_address(KEY2)
 FUND = 10**22
 
 
-def make_chain(diskdb=None, resident=True, commit_interval=4096):
+def make_chain(diskdb=None, resident=True, commit_interval=4096,
+               prefer_host=False):
+    # prefer_host=False pins the DEVICE path: these tests exercise the
+    # resident executor (and its failover), which the CPU-backend host
+    # fast path would otherwise bypass on non-TPU test machines.
     cfg = params.TEST_CHAIN_CONFIG
     diskdb = diskdb if diskdb is not None else MemoryDB()
     state_db = Database(TrieDatabase(diskdb))
@@ -48,7 +52,8 @@ def make_chain(diskdb=None, resident=True, commit_interval=4096):
     return BlockChain(
         diskdb,
         CacheConfig(pruning=True, resident_account_trie=resident,
-                    commit_interval=commit_interval),
+                    commit_interval=commit_interval,
+                    resident_prefer_host=prefer_host),
         cfg,
         genesis,
         new_dummy_engine(),
@@ -267,7 +272,8 @@ class TestResidentStorageContracts:
             )
             return BlockChain(
                 diskdb,
-                CacheConfig(pruning=True, resident_account_trie=resident),
+                CacheConfig(pruning=True, resident_account_trie=resident,
+                            resident_prefer_host=False),
                 params.TEST_CHAIN_CONFIG, genesis, new_dummy_engine(),
                 state_database=Database(TrieDatabase(diskdb)),
             )
@@ -353,7 +359,8 @@ class TestResidentStorageBatch:
             marker = get_batch_keccak("planned") if resident else None
             return BlockChain(
                 diskdb,
-                CacheConfig(pruning=True, resident_account_trie=resident),
+                CacheConfig(pruning=True, resident_account_trie=resident,
+                            resident_prefer_host=False),
                 params.TEST_CHAIN_CONFIG, genesis, new_dummy_engine(),
                 state_database=Database(
                     TrieDatabase(diskdb, batch_keccak=marker)),
@@ -604,4 +611,46 @@ class TestResidentMiner:
         chain.drain_acceptor_queue()
         assert chain.acceptor_error is None
         assert chain.state().get_balance(ADDR2) == FUND + 777
+        chain.stop()
+
+
+class TestResidentCpuFastPath:
+    def test_auto_host_mode_on_cpu_backend(self):
+        """resident_prefer_host='auto' on a CPU backend must boot the
+        mirror HOST-resident (the config-10 regression fix: XLA-CPU is
+        no device — commits run the threaded native hasher) with roots
+        bit-exact vs the default path, observable via the
+        state/resident/cpu_fastpath counter and host_mode."""
+        from coreth_tpu.metrics import default_registry
+
+        c0 = default_registry.counter("state/resident/cpu_fastpath").count()
+        default = make_chain(resident=False)
+        blocks = build_blocks(default, 3, tx_gen())
+        chain = make_chain(prefer_host="auto")
+        assert chain.mirror is not None
+        assert chain.mirror.host_mode, "CPU backend must start host-resident"
+        assert chain.mirror.ex is None, "no executor built on the fast path"
+        assert default_registry.counter(
+            "state/resident/cpu_fastpath").count() == c0 + 1
+        for b in blocks:
+            # insert_block itself asserts mirror root == header.root
+            # (headers were produced default-side at generation time)
+            chain.insert_block(b)
+            chain.accept(b)
+        chain.drain_acceptor_queue()
+        assert chain.acceptor_error is None
+        assert chain.mirror.host_mode
+        s_def = default.state_at(blocks[-1].root)
+        s_res = chain.state_at(blocks[-1].root)
+        assert s_res.get_balance(ADDR2) == s_def.get_balance(ADDR2)
+        default.stop()
+        chain.stop()
+
+    def test_pinned_device_path_still_boots_executor(self):
+        """prefer_host=False (what every device-path test in this file
+        uses) must keep constructing the resident executor."""
+        chain = make_chain()  # make_chain pins prefer_host=False
+        assert chain.mirror is not None
+        assert not chain.mirror.host_mode
+        assert chain.mirror.ex is not None
         chain.stop()

@@ -14,6 +14,13 @@ pytestmark = pytest.mark.skipif(
     load_inc() is None, reason="native incremental planner unavailable")
 
 
+@pytest.fixture(autouse=True)
+def _pin_device_path(monkeypatch):
+    # these oracle tests exercise the resident EXECUTOR; the CPU-backend
+    # host fast path would silently bypass it on non-TPU test machines
+    monkeypatch.setenv("CORETH_TPU_RESIDENT_HOST", "0")
+
+
 def _rand_items(rng, n):
     return {rng.randbytes(32): rng.randbytes(rng.randint(1, 90))
             for _ in range(n)}
