@@ -473,13 +473,24 @@ _RESIDENT_PHASES = (
     "resident/phase/host_hash",
 )
 _PLAN_CACHE = ("resident/plan_cache/hits", "resident/plan_cache/misses")
+# execution-side attribution (PR 2): per-insert phase timers plus the
+# snapshot read-path counters — a config-10 regression names the phase
+_CHAIN_PHASES = (
+    "chain/phase/recover", "chain/phase/verify", "chain/phase/execute",
+    "chain/phase/validate", "chain/phase/commit", "chain/phase/write",
+)
+_SNAP_COUNTERS = (
+    "state/snap/hits", "state/snap/misses", "state/snap/generating",
+)
 
 
 def _phase_snapshot():
     from coreth_tpu.metrics import default_registry
 
-    snap = {p: default_registry.timer(p).total() for p in _RESIDENT_PHASES}
-    snap.update({c: default_registry.counter(c).count() for c in _PLAN_CACHE})
+    snap = {p: default_registry.timer(p).total()
+            for p in _RESIDENT_PHASES + _CHAIN_PHASES}
+    snap.update({c: default_registry.counter(c).count()
+                 for c in _PLAN_CACHE + _SNAP_COUNTERS})
     return snap
 
 
@@ -490,10 +501,18 @@ def _phase_delta(before):
         d = after[p] - before[p]
         if d > 0:
             out[p.rsplit("/", 1)[1] + "_s"] = round(d, 4)
+    for p in _CHAIN_PHASES:
+        d = after[p] - before[p]
+        if d > 0:
+            out["chain_" + p.rsplit("/", 1)[1] + "_s"] = round(d, 4)
     for c in _PLAN_CACHE:
         d = after[c] - before[c]
         if d > 0:
             out["plan_cache_" + c.rsplit("/", 1)[1]] = int(d)
+    for c in _SNAP_COUNTERS:
+        d = after[c] - before[c]
+        if d > 0:
+            out["snap_" + c.rsplit("/", 1)[1]] = int(d)
     return out
 
 
@@ -581,6 +600,21 @@ def bench_11():
           round(t_seg / t_fused, 3))
 
 
+def bench_12():
+    """Interpreter dispatch micro-bench (benches/bench_evm.py): ops/s
+    for a hot-loop contract, legacy dict dispatch vs the fast
+    instruction-stream loop (cold + warm stream cache). vs_baseline =
+    warm-fast / legacy — the per-opcode dispatch speedup, tracked per
+    round like trie_commit_nodes_per_sec."""
+    import bench_evm
+
+    res = bench_evm.measure()
+    print(json.dumps(dict(config=12, **res)), flush=True)
+    _emit(12, "evm_fast_dispatch_ops_per_sec",
+          res["fast_warm_ops_per_sec"], "ops/s",
+          res["speedup_warm_vs_legacy"])
+
+
 def main():
     from coreth_tpu.utils import enable_compilation_cache
 
@@ -598,7 +632,7 @@ def main():
     watchdog = PhaseWatchdog(
         time.monotonic() + float(os.environ.get("CORETH_TPU_BENCH_WATCHDOG",
                                                 "1800")))
-    picks = [int(a) for a in sys.argv[1:]] or list(range(1, 12))
+    picks = [int(a) for a in sys.argv[1:]] or list(range(1, 13))
     for i in picks:
         # configs 7/9 run bench.py legs under their own phase watchdogs
         # with larger budgets (900s cold warmup); the outer arm must not

@@ -40,7 +40,11 @@ class CacheConfig:
     commit_interval: int = 4096
     trie_dirty_limit: int = 256 * 1024 * 1024
     accepted_cache_size: int = 32
-    snapshot_limit: int = 0  # 0 disables the flat snapshot (Phase 4)
+    # flat-snapshot diff-layer budget; 0 disables the snapshot tree and
+    # every state read walks the trie. On by default: with commitment
+    # pipelined (PR 1) the read path sets the tx/s ceiling, and the flat
+    # layers turn per-account trie walks into O(1) dict gets.
+    snapshot_limit: int = 256
     # "auto"/"batched": Trie.hash drains dirty sets >= BATCH_THRESHOLD to the
     # device keccak (trie/trie.go:618-619 parallel-threshold analog); "off":
     # recursive CPU hasher everywhere.
@@ -142,6 +146,24 @@ class BlockChain:
         self._blocks: Dict[bytes, Block] = {}  # block cache by hash
         self._receipts: Dict[bytes, List[Receipt]] = {}
         self._canonical: Dict[int, bytes] = {}
+
+        # overlapped insert tail: once validate_state has proven a block's
+        # root, its rawdb body/receipt writes and snapshot diff-layer
+        # update run on this bounded single-worker queue — block k's disk
+        # tail overlaps block k+1's sender recovery and verification.
+        # Disk readers join the whole queue before touching rawdb;
+        # state_at waits only for the (cheap) snapshot update, so the
+        # expensive RLP encodes never serialize the next execution.
+        # (Created before genesis setup: boot-time reads already join.)
+        self.tail_error: Optional[str] = None
+        self._tail_queue: "queue.Queue[Optional[tuple]]" = queue.Queue(2)
+        self._tail_snap_applied = threading.Event()
+        self._tail_snap_applied.set()
+        self._tail_closed = False
+        self._tail_thread = threading.Thread(
+            target=self._tail_worker, name="insert-tail", daemon=True
+        )
+        self._tail_thread.start()
 
         self.processor = StateProcessor(config, self, engine)
         self.validator = BlockValidator(config, self, engine)
@@ -308,6 +330,7 @@ class BlockChain:
         blk = self._blocks.get(block_hash)
         if blk is not None:
             return blk
+        self.join_tail()  # the block may still be in the insert tail
         number = rawdb.read_header_number(self.diskdb, block_hash)
         if number is None:
             return None
@@ -361,6 +384,7 @@ class BlockChain:
         blk = self._blocks.get(h)
         if blk is not None:
             return blk.header
+        self.join_tail()  # the header may still be in the insert tail
         blob = rawdb.read_header_rlp(self.diskdb, number, h)
         return Header.decode(blob) if blob is not None else None
 
@@ -368,6 +392,7 @@ class BlockChain:
         cached = self._receipts.get(block_hash)
         if cached is not None:
             return cached
+        self.join_tail()  # receipts may still be in the insert tail
         number = rawdb.read_header_number(self.diskdb, block_hash)
         if number is None:
             return None
@@ -446,6 +471,9 @@ class BlockChain:
         return self.has_state(blk.root)
 
     def state_at(self, root: bytes) -> StateDB:
+        # pending diff-layer attaches must land first, or the lookup for
+        # [root] misses and every read in this StateDB walks the trie
+        self._wait_tail_snap()
         return StateDB(root, self.state_database, self.snaps)
 
     def state(self) -> StateDB:
@@ -499,10 +527,19 @@ class BlockChain:
         from .sender_cacher import sender_cacher
         from .types import Signer
 
-        sender_cacher.recover(Signer(self.config.chain_id), block.transactions)
+        with _metrics.timer("chain/phase/recover").time():
+            sender_cacher.recover(
+                Signer(self.config.chain_id), block.transactions)
 
-        self.engine.verify_header(self.config, header, parent)
-        self.validator.validate_body(block)
+        with _metrics.timer("chain/phase/verify").time():
+            self.engine.verify_header(self.config, header, parent)
+            self.validator.validate_body(block)
+
+        # join the recovery batch before execution: losing the race means
+        # re-deriving senders one-by-one mid-execute, which duplicates the
+        # whole batch's work on small machines
+        with _metrics.timer("chain/phase/recover").time():
+            sender_cacher.wait()
 
         statedb = self.state_at(parent.root)
         # warm touched trie paths while txs execute (blockchain.go:1312)
@@ -510,8 +547,12 @@ class BlockChain:
 
         try:
             with insert_timer.time():
-                receipts, logs, used_gas = self.processor.process(block, parent, statedb)
-                self.validator.validate_state(block, statedb, receipts, used_gas)
+                with _metrics.timer("chain/phase/execute").time():
+                    receipts, logs, used_gas = self.processor.process(
+                        block, parent, statedb)
+                with _metrics.timer("chain/phase/validate").time():
+                    self.validator.validate_state(
+                        block, statedb, receipts, used_gas)
         finally:
             statedb.stop_prefetcher()
 
@@ -524,17 +565,21 @@ class BlockChain:
         _metrics.meter("chain/gas/used").mark(used_gas)
 
         # commit state: trie refs live until Accept/Reject balance them;
-        # block hashes key the snapshot diff layer (coreth CommitWithSnap)
-        root = statedb.commit(
-            self.config.is_eip158(header.number),
-            block_hash=block.hash(),
-            parent_block_hash=header.parent_hash,
-        )
-        if root != header.root:
-            raise ChainError("commit root mismatch")
-        self.trie_writer.insert_trie(block)
+        # block hashes key the snapshot diff layer (coreth CommitWithSnap).
+        # The diff-layer attach itself is deferred to the insert-tail
+        # worker along with the rawdb writes (see _tail_worker)
+        with _metrics.timer("chain/phase/commit").time():
+            root = statedb.commit(
+                self.config.is_eip158(header.number),
+                block_hash=block.hash(),
+                parent_block_hash=header.parent_hash,
+                defer_snap=True,
+            )
+            if root != header.root:
+                raise ChainError("commit root mismatch")
+            self.trie_writer.insert_trie(block)
 
-        self._write_block(block, receipts)
+        self._write_block(block, receipts, statedb._deferred_snap_update)
 
         # new tip if it extends the current preference; the chain feed only
         # fires for head changes — non-canonical siblings must not reset
@@ -544,11 +589,24 @@ class BlockChain:
             for fn in self._chain_feed:
                 fn(block, logs)
 
-    def _write_block(self, block: Block, receipts: List[Receipt]) -> None:
+    def _write_block(self, block: Block, receipts: List[Receipt],
+                     snap_update: Optional[tuple] = None) -> None:
+        """Register the block in memory, then hand the disk tail (rawdb
+        writes + snapshot diff-layer attach) to the insert-tail worker."""
         h = block.hash()
-        n = block.number
         self._blocks[h] = block
         self._receipts[h] = receipts
+        # replace the join target BEFORE enqueueing: a reader racing the
+        # swap at worst waits on the already-set previous event and takes
+        # the trie fallback for one read
+        ev = threading.Event()
+        self._tail_snap_applied = ev
+        self._tail_queue.put((block, receipts, snap_update, ev))
+
+    def _write_block_data(self, block: Block, receipts: List[Receipt]) -> None:
+        """rawdb persistence for one inserted block (tail-worker body)."""
+        h = block.hash()
+        n = block.number
         rawdb.write_header_number(self.diskdb, h, n)
         rawdb.write_header_rlp(self.diskdb, n, h, block.header.encode())
         body_items = [
@@ -561,6 +619,48 @@ class BlockChain:
         rawdb.write_receipts_rlp(
             self.diskdb, n, h, rlp.encode([r.encode() for r in receipts])
         )
+
+    def _tail_worker(self) -> None:
+        from ..metrics import default_registry as _metrics
+
+        write_timer = _metrics.timer("chain/phase/write")
+        while True:
+            item = self._tail_queue.get()
+            if item is None:
+                self._tail_queue.task_done()
+                return
+            block, receipts, snap_update, snap_applied = item
+            try:
+                with write_timer.time():
+                    if snap_update is not None:
+                        self.snaps.update(*snap_update)
+                    # layer attached: the next block's state_at can open
+                    # against it while we grind through the RLP encodes
+                    snap_applied.set()
+                    self._write_block_data(block, receipts)
+            except Exception:
+                import traceback
+
+                self.tail_error = traceback.format_exc()
+            finally:
+                snap_applied.set()  # never leave a joiner hanging
+                self._tail_queue.task_done()
+
+    def join_tail(self) -> None:
+        """Wait until every queued insert tail has reached disk; raises
+        (once) if the tail worker failed."""
+        self._tail_queue.join()
+        if self.tail_error is not None:
+            err, self.tail_error = self.tail_error, None
+            raise ChainError(f"insert tail failed:\n{err}")
+
+    def _wait_tail_snap(self) -> None:
+        """Wait only for pending snapshot diff-layer attaches (the cheap
+        head of the tail) — what state reads need for layer lookup."""
+        self._tail_snap_applied.wait()
+        if self.tail_error is not None:
+            err, self.tail_error = self.tail_error, None
+            raise ChainError(f"insert tail failed:\n{err}")
 
     def _write_canonical(self, block: Block) -> None:
         self._canonical[block.number] = block.hash()
@@ -685,6 +785,9 @@ class BlockChain:
     def reject(self, block: Block) -> None:
         """Reject (blockchain.go:1067-1094): drop refs for the losing block."""
         with self.chainmu:
+            # the losing block's tail may still be queued; land it before
+            # dropping the in-memory refs so disk state stays coherent
+            self.join_tail()
             self.trie_writer.reject_trie(block)
             self._blocks.pop(block.hash(), None)
             self._receipts.pop(block.hash(), None)
@@ -713,6 +816,9 @@ class BlockChain:
         from ..metrics import default_registry as _metrics
 
         with _metrics.timer("chain/block/accepts").time():
+            # the accepted block's diff layer and rawdb rows must be down
+            # before flatten folds layers / tx lookups are written
+            self.join_tail()
             if self.snaps is not None:
                 self.snaps.flatten(block.hash())
             self.trie_writer.accept_trie(block)
@@ -798,6 +904,14 @@ class BlockChain:
         self.drain_acceptor_queue()
         self._acceptor_queue.put(None)
         self._acceptor_thread.join(timeout=5)
+        # land every queued insert tail, then retire the worker
+        if not self._tail_closed:
+            self._tail_closed = True
+            try:
+                self.join_tail()
+            finally:
+                self._tail_queue.put(None)
+                self._tail_thread.join(timeout=5)
         self.trie_writer.shutdown()
 
     def last_accepted_block(self) -> Block:

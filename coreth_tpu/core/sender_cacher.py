@@ -15,12 +15,14 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
+from ..metrics import default_registry as _metrics
 from .types import Signer, Transaction
 
 
 class TxSenderCacher:
     def __init__(self, threads: int = 4, batch_recover=None):
-        self._pool = ThreadPoolExecutor(max_workers=max(threads, 1))
+        self.threads = max(threads, 1)
+        self._pool = ThreadPoolExecutor(max_workers=self.threads)
         self._batch_recover = batch_recover
         self._lock = threading.Lock()
         self._futures: list = []
@@ -46,7 +48,11 @@ class TxSenderCacher:
                     try:
                         signer.sender(tx)
                     except Exception:
-                        pass
+                        # recovery here is a prefetch — the insert path
+                        # re-derives senders and surfaces the real error —
+                        # but a malformed-signature flood must be visible
+                        _metrics.counter(
+                            "core/sender_cacher/recover_error").inc()
 
         from ..native import secp
 
@@ -57,7 +63,7 @@ class TxSenderCacher:
         else:
             # pure-Python path: strided split like the reference
             # (sender_cacher.go:100-108) so the pool overlaps work
-            n = min(4, len(txs))
+            n = min(self.threads, len(txs))
             for i in range(n):
                 self._futures.append(self._pool.submit(work_batch, txs[i::n]))
 
@@ -74,5 +80,10 @@ class TxSenderCacher:
         self._pool.shutdown(wait=False)
 
 
-# module-level shared cacher (core/sender_cacher.go txSenderCacher singleton)
-sender_cacher = TxSenderCacher()
+# module-level shared cacher (core/sender_cacher.go txSenderCacher
+# singleton). Fan-out follows the shared CPU-thread policy — the
+# CORETH_TPU_CPU_THREADS env override, else min(16, cores) — instead of a
+# hardcoded width (the reference sizes it runtime.NumCPU()).
+from ..native import default_cpu_threads
+
+sender_cacher = TxSenderCacher(threads=default_cpu_threads())

@@ -227,9 +227,16 @@ class Signer:
         if tx._sender is not None:
             return tx._sender
         recid, protected = self._recid_of(tx)
-        addr = secp256k1.recover_address(
-            self.sig_hash(tx, protected=protected), recid, tx.r, tx.s
-        )
+        msg = self.sig_hash(tx, protected=protected)
+        # native one-shot first: a tx that loses the race with the
+        # background sender-cacher batch must not pay the pure-Python
+        # scalar multiply (~13ms) on the insert path
+        from ..native import secp
+
+        if secp.available():
+            addr = secp.recover_one(msg, recid, tx.r, tx.s)
+        else:
+            addr = secp256k1.recover_address(msg, recid, tx.r, tx.s)
         if addr is None:
             raise ValueError("invalid signature")
         tx._sender = addr

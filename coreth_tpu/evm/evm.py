@@ -19,7 +19,9 @@ from typing import Callable, Optional, Tuple
 from .. import vmerrs
 from ..native import keccak256
 from . import gas as G
-from .interpreter import Contract, Interpreter, jump_table_for_rules
+from .interpreter import (
+    Contract, Interpreter, fast_table_for_rules, jump_table_for_rules,
+)
 from .precompiles import active_precompiles
 
 EMPTY_CODE_HASH = keccak256(b"")
@@ -77,6 +79,9 @@ class Config:
     enable_preimage_recording: bool = False
     extra_eips: tuple = ()
     allow_unfinalized_queries: bool = False
+    # None defers to interpreter.FASTLOOP_DEFAULT / the env override;
+    # True/False pins this EVM to the fast or legacy dispatch loop
+    fastloop: Optional[bool] = None
 
 
 class EVM:
@@ -91,6 +96,7 @@ class EVM:
         self.config = config or Config()
         self.rules = chain_config.rules(block_ctx.block_number, block_ctx.time)
         self.jump_table = jump_table_for_rules(self.rules)
+        self.fast_table = fast_table_for_rules(self.rules)
         self.precompiles = active_precompiles(self.rules)
         self.interpreter = Interpreter(self)
         self.depth = 0

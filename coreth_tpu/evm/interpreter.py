@@ -14,10 +14,12 @@ grown in 32-byte words. Gas lives on the Contract, as in the reference.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import vmerrs
+from ..metrics import default_registry as _metrics
 from ..native import keccak256
 from . import gas as G
 from . import opcodes as OP
@@ -30,6 +32,31 @@ MAX_UINT64 = (1 << 64) - 1
 # the reference caps memory at the largest word-aligned uint64 size
 # (common.go calcMemSize64 / memoryGasCost overflow guard)
 MAX_MEM = 0x1FFFFFFFE0
+
+# run-loop control signals. Execute functions return None to continue,
+# SIG_JUMPED after setting interp.pc, or a (signal, data) pair for the
+# terminating three. Integers compared by identity: one pointer test per
+# step instead of string equality.
+SIG_JUMPED = 1
+SIG_STOP = 2
+SIG_RETURN = 3
+SIG_REVERT = 4
+
+# fast dispatch loop default: pre-parsed instruction streams + 256-entry
+# list jump table. CORETH_TPU_EVM_FASTLOOP=0 (or the evm-fastloop config
+# knob) reverts to the legacy dict-dispatch loop; both are bit-identical
+# in gas, refunds, tracer callbacks, and revert data.
+FASTLOOP_DEFAULT = True
+
+
+def fastloop_enabled(cfg_val: Optional[bool] = None) -> bool:
+    """Resolve the loop choice: env override > per-EVM config > default."""
+    env = os.environ.get("CORETH_TPU_EVM_FASTLOOP")
+    if env is not None and env != "":
+        return env.strip().lower() not in ("0", "false", "off", "no")
+    if cfg_val is not None:
+        return bool(cfg_val)
+    return FASTLOOP_DEFAULT
 
 
 def _signed(x: int) -> int:
@@ -602,12 +629,13 @@ def gas_selfdestruct_eip2929(interp, contract, st, mem, msize) -> int:
 
 
 # --- execute functions ----------------------------------------------------
-# Each returns None to continue, or a (signal, data) tuple:
-#   ("stop", b"") / ("return", data) / ("revert", data)
+# Each returns None to continue, SIG_JUMPED (pc already set), or a
+# (signal, data) tuple: (SIG_STOP, b"") / (SIG_RETURN, data) /
+# (SIG_REVERT, data)
 
 
 def op_stop(interp, scope):
-    return ("stop", b"")
+    return (SIG_STOP, b"")
 
 
 def op_add(interp, scope):
@@ -1010,7 +1038,7 @@ def op_jump(interp, scope):
     if not scope.contract.valid_jumpdest(dest):
         raise vmerrs.ErrInvalidJump
     interp.pc = dest
-    return "jumped"
+    return SIG_JUMPED
 
 
 def op_jumpi(interp, scope):
@@ -1021,7 +1049,7 @@ def op_jumpi(interp, scope):
         if not scope.contract.valid_jumpdest(dest):
             raise vmerrs.ErrInvalidJump
         interp.pc = dest
-        return "jumped"
+        return SIG_JUMPED
 
 
 def op_pc(interp, scope):
@@ -1241,14 +1269,14 @@ def op_return(interp, scope):
     st = scope.stack
     off = st.pop()
     size = st.pop()
-    return ("return", scope.memory.get(off, size))
+    return (SIG_RETURN, scope.memory.get(off, size))
 
 
 def op_revert(interp, scope):
     st = scope.stack
     off = st.pop()
     size = st.pop()
-    return ("revert", scope.memory.get(off, size))
+    return (SIG_REVERT, scope.memory.get(off, size))
 
 
 def op_invalid(interp, scope):
@@ -1265,7 +1293,7 @@ def op_selfdestruct(interp, scope):
     balance = evm.statedb.get_balance(scope.contract.address)
     evm.statedb.add_balance(beneficiary, balance)
     evm.statedb.suicide(scope.contract.address)
-    return ("stop", b"")
+    return (SIG_STOP, b"")
 
 
 # --- jump table construction ---------------------------------------------
@@ -1489,6 +1517,123 @@ def jump_table_for_rules(rules) -> Dict[int, Operation]:
     return jt
 
 
+# --- fast dispatch: list jump table + pre-parsed instruction streams ------
+#
+# The per-step costs the legacy loop pays on EVERY opcode — dict lookup,
+# five attribute loads off the Operation dataclass, a closure call just to
+# read PUSH immediates out of the bytecode — are all decidable at parse
+# time. A FastTable holds the fork's 256-entry operation list and a cache
+# (keyed by code_hash, like _analysis_cache) of instruction streams: one
+# flat tuple per byte position with the Operation fields folded in, PUSH
+# immediates decoded once, and the next pc precomputed (so PUSH data is
+# skipped without a closure call).
+
+
+class FastTable:
+    __slots__ = ("ops", "streams")
+
+    def __init__(self, ops: List[Optional[Operation]]):
+        self.ops = ops
+        self.streams: Dict[bytes, list] = {}
+
+
+_fast_table_cache: Dict[Tuple[bool, ...], FastTable] = {}
+
+
+def fast_table_for_rules(rules) -> FastTable:
+    key = (
+        rules.is_apricot_phase1, rules.is_apricot_phase2,
+        rules.is_apricot_phase3, rules.is_d_upgrade,
+    )
+    ft = _fast_table_cache.get(key)
+    if ft is None:
+        jt = jump_table_for_rules(rules)
+        ft = FastTable([jt.get(i) for i in range(256)])
+        _fast_table_cache[key] = ft
+    return ft
+
+
+def _make_pc_push(v: int) -> ExecFn:
+    # PC is a constant per instruction site: pushing the baked-in value
+    # frees the fast loop from syncing interp.pc before every execute
+    def fn(interp, scope):
+        scope.stack.push(v)
+
+    return fn
+
+
+def build_stream(code: bytes, ops: List[Optional[Operation]]) -> list:
+    """Instruction stream: stream[pc] is (op, execute, constant_gas,
+    min_stack, max_stack, dynamic_gas, memory_size, writes, push_value,
+    next_pc), or None for opcodes outside the fork's table. Entry [len]
+    is the virtual trailing STOP (running off the end halts)."""
+    n = len(code)
+    stream: list = [None] * (n + 1)
+    stop = ops[OP.STOP]
+    stream[n] = (
+        OP.STOP, stop.execute, stop.constant_gas, stop.min_stack,
+        stop.max_stack, stop.dynamic_gas, stop.memory_size, stop.writes,
+        None, n,
+    )
+    for i in range(n):
+        opb = code[i]
+        operation = ops[opb]
+        if operation is None:
+            continue  # invalid opcode: the loop raises without tracing
+        ex = operation.execute
+        pushv = None
+        nxt = i + 1
+        if OP.PUSH1 <= opb <= OP.PUSH32:
+            size = opb - OP.PUSH1 + 2
+            chunk = code[i + 1 : i + size]
+            if len(chunk) < size - 1:
+                chunk = chunk.ljust(size - 1, b"\x00")
+            pushv = int.from_bytes(chunk, "big")
+            nxt = min(i + size, n)
+            ex = None
+        elif ex is op_push0:
+            pushv = 0
+            ex = None
+        elif ex is op_pc:
+            ex = _make_pc_push(i)
+        stream[i] = (
+            opb, ex, operation.constant_gas, operation.min_stack,
+            operation.max_stack, operation.dynamic_gas,
+            operation.memory_size, operation.writes, pushv, nxt,
+        )
+    return stream
+
+
+def _opclass_table() -> List[str]:
+    """256-entry opcode → class map for the sampled execution profile."""
+    cls = ["other"] * 256
+    spans = (
+        (0x00, 0x00, "control"), (0x01, 0x0B, "arith"),
+        (0x10, 0x1D, "bitlogic"), (0x20, 0x20, "keccak"),
+        (0x30, 0x3F, "env"), (0x40, 0x48, "block"),
+        (0x50, 0x50, "stack"), (0x51, 0x53, "memory"),
+        (0x54, 0x55, "storage"), (0x56, 0x58, "control"),
+        (0x59, 0x59, "memory"), (0x5A, 0x5A, "env"),
+        (0x5B, 0x5B, "control"), (0x5F, 0x7F, "push"),
+        (0x80, 0x8F, "dup"), (0x90, 0x9F, "swap"),
+        (0xA0, 0xA4, "log"), (0xF0, 0xF2, "call"),
+        (0xF3, 0xF3, "control"), (0xF4, 0xF5, "call"),
+        (0xFA, 0xFA, "call"), (0xFD, 0xFF, "control"),
+    )
+    for lo, hi, name in spans:
+        for o in range(lo, hi + 1):
+            cls[o] = name
+    return cls
+
+
+_OPCLASS = _opclass_table()
+
+# sample one step in every 2^_OPCLASS_SHIFT in the fast loop: cheap enough
+# to stay always-on, dense enough that a block's profile is representative
+_OPCLASS_SHIFT = 5
+_OPCLASS_MASK = (1 << _OPCLASS_SHIFT) - 1
+
+
 # --- run loop -------------------------------------------------------------
 
 
@@ -1501,6 +1646,7 @@ class Interpreter:
         self.read_only = False
         self.return_data = b""
         self.pc = 0
+        self.fast = fastloop_enabled(getattr(evm.config, "fastloop", None))
 
     def run(self, contract: Contract, input_: bytes, read_only: bool) -> bytes:
         """Execute the contract; raises vmerrs.VMError on failure. A raised
@@ -1519,6 +1665,11 @@ class Interpreter:
             self.read_only, self.return_data, self.pc = saved
 
     def _run(self, contract: Contract, input_: bytes) -> bytes:
+        if self.fast:
+            return self._run_fast(contract, input_)
+        return self._run_legacy(contract, input_)
+
+    def _run_legacy(self, contract: Contract, input_: bytes) -> bytes:
         if not contract.code:
             return b""
         contract.input = input_
@@ -1568,12 +1719,105 @@ class Interpreter:
             if result is None:
                 self.pc += 1  # PUSH executes advance pc past their data
                 continue
-            if result == "jumped":
+            if result is SIG_JUMPED:
                 continue
             signal, data = result
-            if signal == "stop":
+            if signal is SIG_STOP:
                 return b""
-            if signal == "return":
+            if signal is SIG_RETURN:
                 return data
-            if signal == "revert":
-                raise vmerrs.RevertError(data)
+            raise vmerrs.RevertError(data)  # SIG_REVERT
+
+    def _run_fast(self, contract: Contract, input_: bytes) -> bytes:
+        """The list-dispatch loop: same step semantics as _run_legacy —
+        identical gas, refunds, tracer callbacks, and revert data — with
+        the per-step table lookups folded into a pre-parsed instruction
+        stream (see build_stream)."""
+        code = contract.code
+        if not code:
+            return b""
+        contract.input = input_
+        ft = self.evm.fast_table
+        key = contract.code_hash
+        stream = ft.streams.get(key) if key is not None else None
+        if stream is None:
+            stream = build_stream(code, ft.ops)
+            if key is not None and len(ft.streams) < 4096:
+                ft.streams[key] = stream
+        stack = Stack()
+        mem = Memory()
+        scope = Scope(stack, mem, contract)
+        tracer = self.evm.config.tracer
+        read_only = self.read_only
+        use_gas = contract.use_gas
+        sdata = stack.data
+        push = stack.push
+        n = len(code)
+        stop_entry = stream[n]
+        i = 0
+        steps = 0
+        classes: Dict[str, int] = {}
+        opclass = _OPCLASS
+        try:
+            while True:
+                e = stream[i] if i <= n else stop_entry
+                if e is None:
+                    raise vmerrs.ErrInvalidOpcode
+                (opb, ex, cgas, min_st, max_st, dyn, memsz, writes,
+                 pushv, nxt) = e
+                if not (steps & _OPCLASS_MASK):
+                    c = opclass[opb]
+                    classes[c] = classes.get(c, 0) + 1
+                steps += 1
+                slen = len(sdata)
+                if slen < min_st:
+                    raise vmerrs.ErrStackUnderflow
+                if slen > max_st:
+                    raise vmerrs.ErrStackOverflow
+                if read_only and writes:
+                    raise vmerrs.ErrWriteProtection
+                if not use_gas(cgas):
+                    raise vmerrs.ErrOutOfGas
+                if memsz is not None:
+                    msize = memsz(stack)
+                    msize = ((msize + 31) // 32) * 32
+                else:
+                    msize = 0
+                if dyn is not None:
+                    dgas = dyn(self, contract, stack, mem, msize)
+                    if not use_gas(dgas):
+                        raise vmerrs.ErrOutOfGas
+                    if msize > 0:
+                        new_words = msize // 32
+                        total = (G.MEMORY_GAS * new_words
+                                 + new_words * new_words // G.QUAD_COEFF_DIV)
+                        if total > mem.last_gas_cost:
+                            mem.last_gas_cost = total
+                        mem.resize(msize)
+                if tracer is not None:
+                    tracer.capture_state(i, opb, contract.gas + cgas, cgas,
+                                         scope, self.return_data,
+                                         self.evm.depth)
+                if pushv is not None:
+                    # pre-decoded PUSH immediate (also PUSH0): no execute
+                    push(pushv)
+                    i = nxt
+                    continue
+                result = ex(self, scope)
+                if result is None:
+                    i = nxt
+                    continue
+                if result is SIG_JUMPED:
+                    i = self.pc  # op_jump/op_jumpi validated + set the dest
+                    continue
+                signal, data = result
+                if signal is SIG_STOP:
+                    return b""
+                if signal is SIG_RETURN:
+                    return data
+                raise vmerrs.RevertError(data)  # SIG_REVERT
+        finally:
+            if classes:
+                reg = _metrics
+                for c, cnt in classes.items():
+                    reg.counter("chain/opclass/" + c).inc(cnt)

@@ -69,12 +69,18 @@ def load():
         return _lib
 
 
+_OUT32 = ctypes.c_char * 32  # hoisted: create_string_buffer per call is
+# measurable at millions of hashes (type lookup + isinstance checks)
+
+
 def keccak256(data: bytes) -> bytes:
-    lib = load()
+    lib = _lib
     if lib is None:
-        from ..ops.keccak_ref import keccak256 as ref
-        return ref(data)
-    out = ctypes.create_string_buffer(32)
+        lib = load()
+        if lib is None:
+            from ..ops.keccak_ref import keccak256 as ref
+            return ref(data)
+    out = _OUT32()
     lib.keccak256(data, len(data), out)
     return out.raw
 

@@ -15,6 +15,11 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional, Set, Tuple
 
+from ..log import get_logger
+from ..metrics import default_registry as _metrics
+
+_log = get_logger("snapshot")
+
 # rawdb snapshot schema (core/rawdb/schema.go SnapshotAccountPrefix etc.)
 SNAPSHOT_ACCOUNT_PREFIX = b"a"
 SNAPSHOT_STORAGE_PREFIX = b"o"
@@ -160,8 +165,15 @@ class Tree:
                     try:
                         self._generate(root)
                         base.ready = True
-                    except Exception:
-                        pass  # layer stays not-ready; trie remains truth
+                    except Exception as exc:
+                        # layer stays not-ready; trie remains truth — but a
+                        # silent failure would leave every read paying the
+                        # trie walk forever with nothing to show why
+                        _metrics.counter("state/snap/generation_error").inc()
+                        _log.warning(
+                            "snapshot generation failed for root %s: %s",
+                            root.hex()[:12], exc,
+                        )
 
                 self._gen_thread = threading.Thread(target=_bg, daemon=True)
                 self._gen_thread.start()

@@ -14,6 +14,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Set, Tuple
 
 from .. import rlp
+from ..metrics import default_registry as _metrics
 from ..native import keccak256
 from ..core import rawdb
 from ..trie.node import EMPTY_ROOT
@@ -22,7 +23,16 @@ from .access_list import AccessList
 from .account import Account, EMPTY_CODE_HASH, normalize_state_key
 from .database import Database
 from .journal import Journal
+from .snapshot import SnapshotError
 from .state_object import ZERO32, StateObject
+
+# snapshot read-path attribution: a hit answered the read from the diff-
+# layer stack (including authoritative absence), a miss fell back to the
+# trie with snapshots configured, generating means the disk layer was
+# still being built when the read arrived
+_snap_hits = _metrics.counter("state/snap/hits")
+_snap_misses = _metrics.counter("state/snap/misses")
+_snap_generating = _metrics.counter("state/snap/generating")
 
 from .state_object import RIPEMD_ADDR  # noqa: F401  (journal touch quirk)
 
@@ -74,6 +84,9 @@ class StateDB:
         self._snap_destructs: Set[bytes] = set()
         self._snap_accounts: Dict[bytes, bytes] = {}
         self._snap_storage: Dict[bytes, Dict[bytes, bytes]] = {}
+        # Tree.update args stashed by commit(defer_snap=True) for the
+        # chain's insert-tail worker
+        self._deferred_snap_update = None
 
     # ------------------------------------------------------------ object mgmt
 
@@ -114,17 +127,30 @@ class StateDB:
         if self.prefetcher is not None:
             self.prefetcher.prefetch(b"", self.original_root, [addr])
         if self.snap is not None:
-            try:
-                slim = self.snap.account(addr_hash)
-            except Exception:
-                # layer flattened under us: drop the fast path, use the trie
-                self.snap = None
-                slim = None
-            if slim is not None:
-                if len(slim) == 0:
+            slim = None
+            for attempt in (0, 1):
+                try:
+                    slim = self.snap.account(addr_hash)
+                    break
+                except SnapshotError as exc:
+                    self.snap = self._reresolve_snap(attempt, exc)
+                    if self.snap is None:
+                        break
+                except Exception:
+                    self.snap = None
+                    _snap_misses.inc()
+                    break
+            if self.snap is not None:
+                # the snapshot answer is authoritative (snapshot.go:
+                # the disk layer IS the flat state): None means the
+                # account does not exist — no trie fallback
+                _snap_hits.inc()
+                if not slim:
                     return None
                 acct = _slim_to_account(slim)
         if acct is None:
+            if self.snaps is not None and self.snap is None:
+                _snap_misses.inc()
             blob = self.trie.get(addr)
             if not blob:
                 return None
@@ -132,6 +158,25 @@ class StateDB:
         obj = StateObject(self, addr, acct)
         self._objects[addr] = obj
         return obj
+
+    def _reresolve_snap(self, attempt: int, exc: Exception):
+        """A SnapshotError mid-read means generation is still running, or
+        an Accept flattened our layer under us. The flattened case is
+        recoverable: the same state now lives in the new disk layer, so
+        look the root up again (once) instead of abandoning the fast
+        path — dropping it would also skip this block's diff-layer
+        registration at commit and break the Accept that follows."""
+        if attempt == 0 and self.snaps is not None and (
+            "generation in progress" not in str(exc)
+        ):
+            snap = self.snaps.snapshot(self.original_root)
+            if snap is not None:
+                return snap
+        if "generation in progress" in str(exc):
+            _snap_generating.inc()
+        else:
+            _snap_misses.inc()
+        return None
 
     def _get_or_new(self, addr: bytes) -> StateObject:
         obj = self._get_state_object(addr)
@@ -343,15 +388,26 @@ class StateDB:
     def snapshot_storage(self, addr_hash: bytes, key: bytes) -> Optional[bytes]:
         """Flat-snapshot storage read hook used by StateObject."""
         if self.snap is None:
+            if self.snaps is not None:
+                _snap_misses.inc()
             return None
-        try:
-            raw = self.snap.storage(addr_hash, keccak256(key))
-        except Exception:
-            self.snap = None  # flattened under us: fall back to the trie
-            return None
-        if raw is None:
-            return None
-        if len(raw) == 0:
+        raw = None
+        for attempt in (0, 1):
+            try:
+                raw = self.snap.storage(addr_hash, keccak256(key))
+                break
+            except SnapshotError as exc:
+                self.snap = self._reresolve_snap(attempt, exc)
+                if self.snap is None:
+                    return None
+            except Exception:
+                self.snap = None
+                _snap_misses.inc()
+                return None
+        _snap_hits.inc()
+        if not raw:
+            # authoritative absence: the slot was never written (or was
+            # deleted) — zero, with no trie walk
             return ZERO32
         return rlp.decode(raw).rjust(32, b"\x00")
 
@@ -570,11 +626,18 @@ class StateDB:
 
     def commit(self, delete_empty: bool = False,
                block_hash: Optional[bytes] = None,
-               parent_block_hash: Optional[bytes] = None) -> bytes:
+               parent_block_hash: Optional[bytes] = None,
+               defer_snap: bool = False) -> bytes:
         """Commit to the TrieDatabase (statedb.go:1040-1160).
 
         Order: storage tries → code → account trie → TrieDB.Update.
         Returns the new state root.
+
+        defer_snap=True stashes the snapshot diff-layer update as
+        `_deferred_snap_update` (args for Tree.update) instead of applying
+        it, so the chain's insert-tail worker can run it off the critical
+        path; the caller owns applying it before anyone opens a StateDB
+        on the new root.
         """
         from ..metrics import expensive_timer
 
@@ -613,20 +676,25 @@ class StateDB:
         self._objects_dirty = set()
         if root != self.original_root and merged.sets:
             self.db.triedb.update(root, self.original_root, merged)
+        self._deferred_snap_update = None
         if self.snaps is not None and self.snap is not None:
             # identical-root blocks still need their (empty) diff layer:
             # Avalanche blocks are keyed by hash, and Accept will flatten
             # this block_hash (coreth snapshot.go blockLayers semantics)
             if root != self.original_root or block_hash is not None:
-                self.snaps.update(
+                update_args = (
                     root,
                     self.original_root,
                     self._snap_destructs,
                     self._snap_accounts,
                     self._snap_storage,
-                    block_hash=block_hash,
-                    parent_block_hash=parent_block_hash,
+                    block_hash,
+                    parent_block_hash,
                 )
+                if defer_snap:
+                    self._deferred_snap_update = update_args
+                else:
+                    self.snaps.update(*update_args)
             self._snap_destructs, self._snap_accounts, self._snap_storage = (
                 set(), {}, {},
             )
@@ -665,6 +733,7 @@ class StateDB:
         s._snap_destructs = set(self._snap_destructs)
         s._snap_accounts = dict(self._snap_accounts)
         s._snap_storage = {k: dict(v) for k, v in self._snap_storage.items()}
+        s._deferred_snap_update = None
         return s
 
 

@@ -12,6 +12,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from ..metrics import count_drop
+
 
 class _SubFetcher:
     """One background worker warming one trie (trie_prefetcher.go:212+)."""
@@ -42,6 +44,9 @@ class _SubFetcher:
                 else self.db.open_storage_trie(self.owner, self.root)
             )
         except Exception:
+            # a warmer that cannot even open its trie is a silent no-op
+            # for correctness, but the drop must be visible
+            count_drop("state/prefetch/error")
             return
         while True:
             self.wake.wait(timeout=0.5)
@@ -57,7 +62,9 @@ class _SubFetcher:
                 try:
                     trie.get(key)  # resolves + caches the path's nodes
                 except Exception:
-                    pass
+                    # prefetch is best-effort — the real read will fault
+                    # the node in — but never drop silently
+                    count_drop("state/prefetch/error")
 
     def stop(self) -> None:
         self.stop_flag = True

@@ -43,6 +43,10 @@ class Config:
     preimages_enabled: bool = False
     snapshot_async: bool = True
     snapshot_verification_enabled: bool = False
+    # fast EVM dispatch loop (pre-parsed instruction streams); false
+    # reverts to the legacy dict-dispatch loop. The CORETH_TPU_EVM_FASTLOOP
+    # env var overrides either way.
+    evm_fastloop: bool = True
 
     # --- pruning ----------------------------------------------------------
     pruning_enabled: bool = True

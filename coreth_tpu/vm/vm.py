@@ -149,6 +149,10 @@ class VM:
 
             _metrics.enabled_expensive = (
                 self.full_config.metrics_expensive_enabled)
+        if "evm_fastloop" in explicit:
+            from ..evm import interpreter as _interp
+
+            _interp.FASTLOOP_DEFAULT = bool(self.full_config.evm_fastloop)
 
         # node keystore (node/ keystore dir role; backs avax.importKey/
         # exportKey/import/export and the eth/personal signing RPC)

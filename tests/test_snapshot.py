@@ -68,6 +68,8 @@ class TestTree:
         for b in blocks:
             chain.insert_block(b)
             # each insert registers a diff layer keyed by block hash
+            # (attached by the insert-tail worker — join before looking)
+            chain.join_tail()
             assert chain.snaps.get_block_snapshot(b.hash()) is not None
         for b in blocks:
             chain.accept(b)
@@ -103,6 +105,7 @@ class TestTree:
         )
         chain.insert_block(fork_a[0])
         chain.insert_block(fork_b[0])
+        chain.join_tail()
         assert chain.snaps.get_block_snapshot(fork_a[0].hash()) is not None
         assert chain.snaps.get_block_snapshot(fork_b[0].hash()) is not None
         chain.accept(fork_b[0])
