@@ -137,6 +137,12 @@ class KeyStore:
                     kj = json.load(f)
                 out.append(Account(bytes.fromhex(kj["address"]), path))
             except Exception:
+                # corrupt/foreign file in the keystore dir: skipping is
+                # correct, skipping invisibly is not — operators discover
+                # missing accounts otherwise
+                from ..metrics import count_drop
+
+                count_drop("accounts/keystore/unreadable_file")
                 continue
         return out
 

@@ -71,6 +71,10 @@ class Manager:
             try:
                 acct = self.external.find(address)
             except Exception:
+                # daemon down: same countable degradation as list()
+                from ..metrics import count_drop
+
+                count_drop("accounts/external/find_error")
                 acct = None
         return acct
 
@@ -95,7 +99,11 @@ class Manager:
             try:
                 fn(ev)
             except Exception:
-                pass  # one bad subscriber must not starve the rest
+                # one bad subscriber must not starve the rest — but a
+                # permanently throwing sink is an operator bug to surface
+                from ..metrics import count_drop
+
+                count_drop("accounts/subscriber_error")
 
     # --- directory watch --------------------------------------------------
 

@@ -1,0 +1,38 @@
+"""Repo-native static analysis (the `go vet` role, SURVEY §5).
+
+`python -m coreth_tpu.analysis` walks the package with the SA001–SA005
+rule set and exits non-zero on any finding outside the checked-in
+allowlist (`coreth_tpu/analysis/baseline.txt`).  Tier-1 gate:
+tests/test_static_analysis.py runs the same entry point.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from .engine import (BaselineError, Engine, Finding, SourceFile,
+                     apply_baseline, load_baseline)
+from .rules import ALL_RULES, default_rules
+
+PACKAGE_ROOT = Path(__file__).resolve().parent.parent
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.txt"
+
+__all__ = [
+    "ALL_RULES", "BASELINE_PATH", "BaselineError", "Engine", "Finding",
+    "PACKAGE_ROOT", "SourceFile", "apply_baseline", "default_rules",
+    "load_baseline", "run_repo",
+]
+
+
+def run_repo(package_root: Optional[Path] = None,
+             baseline_path: Optional[Path] = None,
+             ) -> Tuple[List[Finding], List[Finding], List[str], Dict[str, str]]:
+    """Analyze the package. Returns (new, suppressed, unused_baseline_keys,
+    baseline) — `new` non-empty means the gate is red."""
+    engine = Engine(default_rules())
+    findings = engine.check_package(package_root or PACKAGE_ROOT)
+    bp = baseline_path if baseline_path is not None else BASELINE_PATH
+    baseline = load_baseline(bp) if bp.exists() else {}
+    new, suppressed, unused = apply_baseline(findings, baseline)
+    return new, suppressed, unused, baseline

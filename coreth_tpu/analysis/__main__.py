@@ -1,0 +1,81 @@
+"""CLI: `python -m coreth_tpu.analysis [options]`.
+
+Exit codes: 0 clean (every finding baselined), 1 new findings or stale
+baseline entries with --strict-baseline, 2 bad invocation/baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from . import (BASELINE_PATH, PACKAGE_ROOT, BaselineError, run_repo)
+from .rules import ALL_RULES
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m coreth_tpu.analysis",
+        description="repo-native static analysis (SA001-SA005)")
+    ap.add_argument("--package", type=Path, default=PACKAGE_ROOT,
+                    help="package dir to walk (default: coreth_tpu)")
+    ap.add_argument("--baseline", type=Path, default=BASELINE_PATH,
+                    help="allowlist file (default: analysis/baseline.txt)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the allowlist")
+    ap.add_argument("--json", action="store_true", help="machine output")
+    ap.add_argument("--strict-baseline", action="store_true",
+                    help="fail on stale allowlist entries too")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="append new findings to the allowlist as TODO "
+                         "entries (then edit in real justifications)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for cls in ALL_RULES:
+            print(f"{cls.id}  {cls.title}")
+        return 0
+
+    try:
+        new, suppressed, unused, baseline = run_repo(
+            args.package, args.baseline if not args.no_baseline else Path("/nonexistent"))
+    except BaselineError as exc:
+        print(f"baseline error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps({
+            "new": [f.__dict__ for f in new],
+            "suppressed": len(suppressed),
+            "unused_baseline": unused,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        for key in unused:
+            print(f"warning: stale baseline entry (no longer fires): {key}",
+                  file=sys.stderr)
+        print(f"{len(new)} finding(s), {len(suppressed)} baselined, "
+              f"{len(unused)} stale baseline entr{'y' if len(unused)==1 else 'ies'}",
+              file=sys.stderr)
+
+    if args.write_baseline and new:
+        with args.baseline.open("a") as fh:
+            for f in new:
+                fh.write(f"{f.rule} {f.path}:{f.qualname} — TODO: justify "
+                         f"({f.message})\n")
+        print(f"appended {len(new)} entries to {args.baseline}",
+              file=sys.stderr)
+
+    if new:
+        return 1
+    if unused and args.strict_baseline:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

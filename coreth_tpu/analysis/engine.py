@@ -1,0 +1,220 @@
+"""AST lint engine — the repo-native analog of `go vet` (SURVEY §5).
+
+The reference coreth keeps its concurrency and error-handling discipline
+honest with `go vet` + `go test -race`; this package is the Python port's
+equivalent: a small AST walker (`Engine`) over pluggable `Rule` visitors,
+each encoding one repo-specific invariant (silent excepts, lock
+discipline, hot-path purity, consensus float-freedom, unordered
+iteration into hashing).  Findings carry file:line + rule id + the
+enclosing qualname, and are keyed `RULE:relpath:qualname` so the
+checked-in baseline (`analysis/baseline.txt`) survives line drift.
+
+Source-level annotations the rules understand (scanned from comments):
+
+    # guarded-by: <lockattr>   on an attribute assignment → that
+                               attribute must only be mutated with
+                               self.<lockattr> held
+    # guarded-by: <lockattr>   on a `def` line → the method's CALLER
+                               holds the lock (helper-under-lock), so
+                               writes inside it count as guarded
+    # hot-path                 on a `def` line → SA003 purity rules
+                               apply to the function body
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+HOT_PATH_RE = re.compile(r"#\s*hot-path\b")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str            # "SA001"
+    path: str            # repo-relative posix path
+    line: int
+    qualname: str        # enclosing Class.method / function / "<module>"
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Baseline key: stable across line drift within one function."""
+        return f"{self.rule}:{self.path}:{self.qualname}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.rule} [{self.qualname}] {self.message}"
+
+
+@dataclass
+class SourceFile:
+    relpath: str
+    text: str
+    tree: ast.Module
+    # line -> comment text (comments only; from tokenize, so string
+    # literals containing '#' can never masquerade as annotations)
+    comments: Dict[int, str] = field(default_factory=dict)
+    # line -> lock name from a `# guarded-by: <lock>` annotation
+    guarded_by: Dict[int, str] = field(default_factory=dict)
+    # lines carrying a `# hot-path` marker
+    hot_lines: frozenset = frozenset()
+
+    @classmethod
+    def from_source(cls, text: str, relpath: str = "<fixture>") -> "SourceFile":
+        tree = ast.parse(text)
+        comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:
+            pass  # a truncated final line still yields earlier comments
+        guarded = {}
+        hot = set()
+        for line, c in comments.items():
+            m = GUARDED_BY_RE.search(c)
+            if m:
+                guarded[line] = m.group(1)
+            if HOT_PATH_RE.search(c):
+                hot.add(line)
+        return cls(relpath=relpath, text=text, tree=tree,
+                   comments=comments, guarded_by=guarded,
+                   hot_lines=frozenset(hot))
+
+    def def_annotation(self, node: ast.AST) -> Tuple[Optional[str], bool]:
+        """(guarded-by lock, hot?) for a def: the annotation comment may sit
+        anywhere on the signature (multi-line defs included), the line above
+        the def, or a decorator line."""
+        body = getattr(node, "body", None)
+        sig_end = body[0].lineno - 1 if body else node.lineno
+        lines = list(range(node.lineno, max(node.lineno, sig_end) + 1))
+        if getattr(node, "decorator_list", None):
+            lines.extend(d.lineno for d in node.decorator_list)
+        lines.append(min(lines) - 1)
+        lock = None
+        hot = False
+        for ln in lines:
+            if ln in self.guarded_by and lock is None:
+                lock = self.guarded_by[ln]
+            if ln in self.hot_lines:
+                hot = True
+        return lock, hot
+
+
+class Rule:
+    """One invariant. Subclasses set `id`/`title` and implement check()."""
+
+    id: str = "SA000"
+    title: str = ""
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, src: SourceFile, node: ast.AST, qualname: str,
+                message: str) -> Finding:
+        return Finding(self.id, src.relpath, getattr(node, "lineno", 0),
+                       qualname, message)
+
+
+class QualnameVisitor(ast.NodeVisitor):
+    """NodeVisitor that tracks the enclosing Class.method qualname."""
+
+    def __init__(self):
+        self._stack: List[str] = []
+
+    @property
+    def qualname(self) -> str:
+        return ".".join(self._stack) if self._stack else "<module>"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def _visit_func(self, node) -> None:
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+
+# --------------------------------------------------------------- baseline
+
+class BaselineError(ValueError):
+    pass
+
+
+def load_baseline(path: Path) -> Dict[str, str]:
+    """Parse the allowlist: one `RULE path:qualname — justification` per
+    line; '#' comments and blanks skipped.  A missing justification is an
+    error — the allowlist must say WHY each site is exempt."""
+    entries: Dict[str, str] = {}
+    for n, raw in enumerate(path.read_text().splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r"^(SA\d{3})\s+(\S+)\s+[—-]+\s*(.+)$", line)
+        if not m:
+            raise BaselineError(f"{path.name}:{n}: unparseable entry: {raw!r}")
+        rule, site, why = m.groups()
+        if not why.strip():
+            raise BaselineError(f"{path.name}:{n}: missing justification")
+        entries[f"{rule}:{site}"] = why.strip()
+    return entries
+
+
+def apply_baseline(findings: List[Finding], baseline: Dict[str, str]):
+    """Split into (new, suppressed, unused-baseline-keys)."""
+    new: List[Finding] = []
+    suppressed: List[Finding] = []
+    used = set()
+    for f in findings:
+        if f.key in baseline:
+            suppressed.append(f)
+            used.add(f.key)
+        else:
+            new.append(f)
+    unused = sorted(set(baseline) - used)
+    return new, suppressed, unused
+
+
+# ----------------------------------------------------------------- engine
+
+class Engine:
+    def __init__(self, rules: Iterable[Rule]):
+        self.rules = list(rules)
+
+    def check_source(self, text: str, relpath: str = "<fixture>") -> List[Finding]:
+        src = SourceFile.from_source(text, relpath)
+        out: List[Finding] = []
+        for rule in self.rules:
+            out.extend(rule.check(src))
+        return out
+
+    def check_file(self, path: Path, root: Path) -> List[Finding]:
+        rel = path.relative_to(root.parent).as_posix()
+        try:
+            text = path.read_text()
+        except (OSError, UnicodeDecodeError) as exc:
+            return [Finding("SA000", rel, 0, "<module>", f"unreadable: {exc}")]
+        try:
+            return self.check_source(text, rel)
+        except SyntaxError as exc:
+            return [Finding("SA000", rel, exc.lineno or 0, "<module>",
+                            f"syntax error: {exc.msg}")]
+
+    def check_package(self, package_root: Path) -> List[Finding]:
+        """Walk every .py under [package_root] (the coreth_tpu dir)."""
+        out: List[Finding] = []
+        for path in sorted(package_root.rglob("*.py")):
+            out.extend(self.check_file(path, package_root))
+        out.sort(key=lambda f: (f.path, f.line, f.rule))
+        return out

@@ -1,0 +1,569 @@
+"""Repo-specific lint rules (the `go vet` analyzers this port needs).
+
+SA001 silent-except    broad `except` that neither re-raises, logs, nor
+                       counts — consensus-relevant failures must be loud
+SA002 lock-discipline  attributes written under `self.<lock>` (or
+                       annotated `# guarded-by: <lock>`) must never be
+                       mutated outside it
+SA003 hot-path-purity  `# hot-path` functions must not read wall-clock,
+                       draw randomness, or allocate ctypes buffers per
+                       call
+SA004 consensus-float  no float arithmetic where bit-exactness is the
+                       product: trie/, rlp, evm gas, state hashing
+SA005 unordered-iter   no set-order-dependent iteration feeding RLP or
+                       hashing (bytes/str hashes are salted per process:
+                       set order is not reproducible across nodes)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .engine import Finding, QualnameVisitor, Rule, SourceFile
+
+__all__ = ["ALL_RULES", "default_rules"]
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'time.time' for Attribute chains / Names; None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def self_attr_base(node: ast.AST) -> Optional[str]:
+    """The `X` in self.X / self.X[...] / self.X.setdefault(...)[...]:
+    unwraps subscripts and call chains down to an attribute on `self`."""
+    while True:
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                return node.attr
+            node = node.value
+        else:
+            return None
+
+
+# ------------------------------------------------------------------ SA001
+
+BROAD_EXC_NAMES = {"Exception", "BaseException"}
+LOG_ATTRS = {"trace", "debug", "info", "warning", "warn", "error",
+             "exception", "critical", "fatal", "log", "print_exc"}
+METRIC_ATTRS = {"inc", "dec", "mark", "observe"}
+HANDLER_NAME_HINTS = ("count", "drop", "error", "metric", "record",
+                      "violation", "reject")
+CAPTURE_NAME_HINTS = ("error", "err", "failed", "drop", "violation")
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [dotted(e) for e in t.elts]
+    else:
+        names = [dotted(t)]
+    return any(n is not None and n.split(".")[-1] in BROAD_EXC_NAMES
+               for n in names)
+
+
+def _target_name(t: ast.AST) -> str:
+    """Dotted name of an assignment target; for subscripts a constant
+    string key joins in, so `out["error"] = …` reads as handling."""
+    if isinstance(t, ast.Subscript):
+        key = t.slice
+        key_s = key.value if (isinstance(key, ast.Constant)
+                              and isinstance(key.value, str)) else ""
+        return f"{_target_name(t.value)}.{key_s}"
+    return dotted(t) or ""
+
+
+def _handler_is_loud(handler: ast.ExceptHandler) -> bool:
+    """Does the handler body re-raise, log, count, capture, or answer the
+    error in-band (a response carrying an `error` field)?"""
+    for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            low = name.lower()
+            if isinstance(fn, ast.Attribute) and name in LOG_ATTRS:
+                return True
+            if isinstance(fn, ast.Attribute) and name in METRIC_ATTRS:
+                return True
+            if any(h in low for h in HANDLER_NAME_HINTS):
+                return True
+            # error-collection idiom: errors.append(...) / errs.add(...)
+            if isinstance(fn, ast.Attribute) and name in ("append", "add"):
+                recv = dotted(fn.value) or ""
+                if any(h in recv.lower() for h in CAPTURE_NAME_HINTS):
+                    return True
+            # in-band error replies: Response(error=...) keywords or a
+            # dict-literal payload with an "error" key
+            if any(kw.arg and "error" in kw.arg.lower()
+                   for kw in node.keywords):
+                return True
+            for arg in node.args:
+                if isinstance(arg, ast.Dict) and any(
+                        isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                        and "error" in k.value.lower()
+                        for k in arg.keys):
+                    return True
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                tname = _target_name(t)
+                if any(h in tname.lower() for h in CAPTURE_NAME_HINTS):
+                    return True
+    return False
+
+
+class SilentExceptRule(Rule):
+    id = "SA001"
+    title = "broad except neither re-raises, logs, nor counts"
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        rule = self
+        findings: List[Finding] = []
+
+        class V(QualnameVisitor):
+            def visit_Try(self, node: ast.Try) -> None:
+                for h in node.handlers:
+                    if _is_broad_handler(h) and not _handler_is_loud(h):
+                        exc = "except" if h.type is None else (
+                            f"except {ast.unparse(h.type)}")
+                        findings.append(rule.finding(
+                            src, h, self.qualname,
+                            f"`{exc}` swallows silently: re-raise, log, "
+                            f"or bump a metrics counter"))
+                self.generic_visit(node)
+
+            visit_TryStar = visit_Try  # 3.11 except* groups
+
+        V().visit(src.tree)
+        return iter(findings)
+
+
+# ------------------------------------------------------------------ SA002
+
+LOCK_ATTR_HINTS = ("lock", "mu", "cond", "_cv")
+# methods mutating their receiver in place (queue put/get excluded:
+# queues synchronize themselves)
+MUTATOR_ATTRS = {"append", "appendleft", "add", "remove", "discard", "pop",
+                 "popleft", "popitem", "clear", "extend", "insert",
+                 "setdefault", "sort", "reverse"}
+ALL_LOCKS = "<all>"
+
+
+def _is_lock_name(attr: str) -> bool:
+    low = attr.lower()
+    return any(h in low for h in LOCK_ATTR_HINTS)
+
+
+class _Write:
+    __slots__ = ("qualname", "line", "locks", "in_init")
+
+    def __init__(self, qualname: str, line: int, locks: frozenset, in_init: bool):
+        self.qualname = qualname
+        self.line = line
+        self.locks = locks
+        self.in_init = in_init
+
+
+class _MethodWalker(ast.NodeVisitor):
+    """Collect self-attribute writes in one method with the set of
+    self-locks held (via `with self.<lock>:`) at each write site."""
+
+    def __init__(self, src: SourceFile, cls: str, method: str,
+                 entry_locks: frozenset, writes: Dict[str, List["_Write"]]):
+        self.src = src
+        self.cls = cls
+        self.method = method
+        self.locks = set(entry_locks)
+        self.writes = writes
+        self.in_init = method == "__init__"
+        self._annotations: Dict[str, str] = {}
+
+    # -- lock scope ------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        held = []
+        for item in node.items:
+            ctx = item.context_expr
+            base = self_attr_base(ctx)
+            if base is not None and _is_lock_name(base):
+                held.append(base)
+        self.locks.update(held)
+        for stmt in node.body:
+            self.visit(stmt)
+        for h in held:
+            self.locks.discard(h)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # a closure runs later, on whatever thread calls it: the lock the
+        # enclosing method holds is NOT held there
+        lock, _hot = self.src.def_annotation(node)
+        entry = frozenset([lock]) if lock else frozenset()
+        inner = _MethodWalker(self.src, self.cls,
+                              f"{self.method}.{node.name}", entry, self.writes)
+        for stmt in node.body:
+            inner.visit(stmt)
+        self._annotations.update(inner._annotations)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass  # no statements, no writes
+
+    # -- writes ----------------------------------------------------------
+    def _record(self, node: ast.AST, attr: str) -> None:
+        if _is_lock_name(attr):
+            return  # the locks themselves are assigned freely in __init__
+        self.writes.setdefault(attr, []).append(_Write(
+            f"{self.cls}.{self.method}", getattr(node, "lineno", 0),
+            frozenset(self.locks), self.in_init))
+        ann = self.src.guarded_by.get(getattr(node, "lineno", -1))
+        if ann:
+            self._annotations[attr] = ann
+
+    def _record_target(self, node: ast.AST, target: ast.AST) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._record_target(node, e)
+            return
+        base = self_attr_base(target)
+        if base is not None:
+            self._record(node, base)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._record_target(node, t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_target(node, node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_target(node, node.target)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            self._record_target(node, t)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in MUTATOR_ATTRS:
+            base = self_attr_base(fn.value)
+            if base is not None:
+                self._record(node, base)
+        self.generic_visit(node)
+
+
+class LockDisciplineRule(Rule):
+    id = "SA002"
+    title = "guarded attribute mutated outside its lock"
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        findings: List[Finding] = []
+        for cls in [n for n in ast.walk(src.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            findings.extend(self._check_class(src, cls))
+        return iter(findings)
+
+    def _check_class(self, src: SourceFile, cls: ast.ClassDef) -> List[Finding]:
+        writes: Dict[str, List[_Write]] = {}
+        annotations: Dict[str, str] = {}
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            lock, _hot = src.def_annotation(item)
+            if lock:
+                entry = frozenset([lock])
+            elif item.name.endswith("_locked"):
+                # `_locked` naming convention: caller holds *a* lock; which
+                # one is not recoverable statically, so trust the name
+                entry = frozenset([ALL_LOCKS])
+            else:
+                entry = frozenset()
+            walker = _MethodWalker(src, cls.name, item.name, entry, writes)
+            for stmt in item.body:
+                walker.visit(stmt)
+            annotations.update(walker._annotations)
+
+        out: List[Finding] = []
+        for attr, ws in sorted(writes.items()):
+            live = [w for w in ws if not w.in_init]
+            if not live:
+                continue
+            if attr in annotations:
+                lock = annotations[attr]
+                for w in live:
+                    if lock not in w.locks and ALL_LOCKS not in w.locks:
+                        out.append(Finding(
+                            self.id, src.relpath, w.line, w.qualname,
+                            f"`self.{attr}` is `# guarded-by: {lock}` but "
+                            f"written without holding it"))
+                continue
+            inside = [w for w in live if w.locks]
+            outside = [w for w in live
+                       if not w.locks and ALL_LOCKS not in w.locks]
+            if inside and outside:
+                lock_names = sorted({l for w in inside for l in w.locks
+                                     if l != ALL_LOCKS})
+                for w in outside:
+                    out.append(Finding(
+                        self.id, src.relpath, w.line, w.qualname,
+                        f"`self.{attr}` is written under "
+                        f"{'/'.join(lock_names) or 'a lock'} elsewhere but "
+                        f"mutated here without it"))
+        return out
+
+
+# ------------------------------------------------------------------ SA003
+
+WALLCLOCK_CALLS = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.process_time",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+}
+RANDOM_ROOTS = ("random.", "np.random.", "numpy.random.", "secrets.")
+CTYPES_ALLOC = {"ctypes.create_string_buffer", "ctypes.create_unicode_buffer",
+                "create_string_buffer", "create_unicode_buffer"}
+
+
+class HotPathPurityRule(Rule):
+    id = "SA003"
+    title = "hot-path function is impure per call"
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        rule = self
+        findings: List[Finding] = []
+
+        class V(QualnameVisitor):
+            def _visit_func(self, node) -> None:
+                _lock, hot = src.def_annotation(node)
+                if hot:
+                    self._stack.append(node.name)
+                    qn = self.qualname
+                    for sub in ast.walk(node):
+                        msg = rule._impurity(sub)
+                        if msg:
+                            findings.append(rule.finding(src, sub, qn, msg))
+                    self._stack.pop()
+                else:
+                    QualnameVisitor._visit_func(self, node)
+
+            visit_FunctionDef = _visit_func
+            visit_AsyncFunctionDef = _visit_func
+
+        V().visit(src.tree)
+        return iter(findings)
+
+    def _impurity(self, node: ast.AST) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        name = dotted(node.func)
+        if name is None:
+            # `(ctypes.c_uint8 * n)(...)` — array-type instantiation
+            if isinstance(node.func, ast.BinOp):
+                parts = " ".join(filter(None, (dotted(node.func.left),
+                                               dotted(node.func.right))))
+                if "ctypes" in parts or ".c_" in parts or parts.startswith("c_"):
+                    return ("allocates a ctypes buffer per call — hoist it "
+                            "(see the PR-2 keccak buffer hoist)")
+            return None
+        if name in WALLCLOCK_CALLS:
+            return f"reads wall-clock (`{name}`) inside a hot path"
+        if any(name.startswith(r) for r in RANDOM_ROOTS):
+            return f"draws randomness (`{name}`) inside a hot path"
+        if name in CTYPES_ALLOC:
+            return (f"allocates a ctypes buffer per call (`{name}`) — "
+                    f"hoist it out of the hot loop")
+        return None
+
+
+# ------------------------------------------------------------------ SA004
+
+# Where bit-exactness is the product.  Device-orchestration files under
+# trie/ (resident_mirror, planned) keep float *timings*; their roots are
+# verified bit-exact against the host path elsewhere, so they are listed
+# out of scope rather than baselined line-by-line.
+CONSENSUS_FLOAT_PATHS = (
+    "coreth_tpu/trie/", "coreth_tpu/rlp.py", "coreth_tpu/evm/gas.py",
+    "coreth_tpu/params/", "coreth_tpu/core/types.py",
+)
+CONSENSUS_FLOAT_EXCLUDE = (
+    "coreth_tpu/trie/resident_mirror.py", "coreth_tpu/trie/planned.py",
+    "coreth_tpu/trie/triedb.py",
+)
+
+
+def _in_scope(relpath: str, paths, exclude=()) -> bool:
+    if any(relpath == e or relpath.startswith(e) for e in exclude):
+        return False
+    return any(relpath == p or relpath.startswith(p) for p in paths)
+
+
+class ConsensusFloatRule(Rule):
+    id = "SA004"
+    title = "float arithmetic in a bit-exact module"
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        if not _in_scope(src.relpath, CONSENSUS_FLOAT_PATHS,
+                         CONSENSUS_FLOAT_EXCLUDE):
+            return iter(())
+        rule = self
+        findings: List[Finding] = []
+
+        class V(QualnameVisitor):
+            def visit_Constant(self, node: ast.Constant) -> None:
+                if isinstance(node.value, float):
+                    findings.append(rule.finding(
+                        src, node, self.qualname,
+                        f"float literal {node.value!r} in a consensus "
+                        f"module (bit-exactness)"))
+
+            def visit_BinOp(self, node: ast.BinOp) -> None:
+                if isinstance(node.op, ast.Div):
+                    findings.append(rule.finding(
+                        src, node, self.qualname,
+                        "true division `/` yields float — use `//` in "
+                        "consensus arithmetic"))
+                self.generic_visit(node)
+
+            def visit_Call(self, node: ast.Call) -> None:
+                name = dotted(node.func)
+                if name == "float" or (name or "").startswith("math."):
+                    findings.append(rule.finding(
+                        src, node, self.qualname,
+                        f"`{name}` produces floats in a consensus module"))
+                self.generic_visit(node)
+
+        V().visit(src.tree)
+        return iter(findings)
+
+
+# ------------------------------------------------------------------ SA005
+
+UNORDERED_ITER_PATHS = CONSENSUS_FLOAT_PATHS + (
+    "coreth_tpu/state/statedb.py", "coreth_tpu/state/snapshot.py",
+    "coreth_tpu/trie/resident_mirror.py", "coreth_tpu/trie/planned.py",
+    "coreth_tpu/trie/triedb.py",
+)
+ITER_UNWRAP = {"list", "tuple", "iter", "enumerate", "reversed"}
+SET_BINOPS = (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)
+
+
+class UnorderedIterationRule(Rule):
+    id = "SA005"
+    title = "set-order-dependent iteration feeding RLP/hashing"
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        if not _in_scope(src.relpath, UNORDERED_ITER_PATHS):
+            return iter(())
+        rule = self
+        findings: List[Finding] = []
+
+        class V(QualnameVisitor):
+            def __init__(self):
+                super().__init__()
+                self._set_locals: List[Set[str]] = [set()]
+
+            def _visit_func(self, node) -> None:
+                self._set_locals.append(set())
+                QualnameVisitor._visit_func(self, node)
+                self._set_locals.pop()
+
+            visit_FunctionDef = _visit_func
+            visit_AsyncFunctionDef = _visit_func
+
+            def visit_Assign(self, node: ast.Assign) -> None:
+                if rule._is_set_expr(node.value, self._set_locals[-1]):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self._set_locals[-1].add(t.id)
+                else:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self._set_locals[-1].discard(t.id)
+                self.generic_visit(node)
+
+            def _check_iter(self, it: ast.AST, where: ast.AST) -> None:
+                if rule._is_set_expr(it, self._set_locals[-1], unwrap=True):
+                    findings.append(rule.finding(
+                        src, where, self.qualname,
+                        "iterating a set here is not reproducible across "
+                        "processes (salted hashes) — wrap in sorted()"))
+
+            def visit_For(self, node: ast.For) -> None:
+                self._check_iter(node.iter, node)
+                self.generic_visit(node)
+
+            def _visit_comp(self, node) -> None:
+                for gen in node.generators:
+                    self._check_iter(gen.iter, node)
+                self.generic_visit(node)
+
+            visit_ListComp = _visit_comp
+            visit_SetComp = _visit_comp
+            visit_DictComp = _visit_comp
+            visit_GeneratorExp = _visit_comp
+
+        V().visit(src.tree)
+        return iter(findings)
+
+    def _is_set_expr(self, node: ast.AST, set_locals: Set[str],
+                     unwrap: bool = False) -> bool:
+        if unwrap:
+            while (isinstance(node, ast.Call)
+                   and dotted(node.func) in ITER_UNWRAP and node.args):
+                node = node.args[0]
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name in ("set", "frozenset"):
+                return True
+            # dict-view algebra (`a.keys() - b.keys()`) returns a set
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in set_locals
+        if isinstance(node, ast.BinOp) and isinstance(node.op, SET_BINOPS):
+            return (self._is_set_expr(node.left, set_locals)
+                    or self._is_set_expr(node.right, set_locals)
+                    or self._is_keys_call(node.left)
+                    or self._is_keys_call(node.right))
+        return False
+
+    @staticmethod
+    def _is_keys_call(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("keys", "items"))
+
+
+ALL_RULES: Tuple[type, ...] = (
+    SilentExceptRule, LockDisciplineRule, HotPathPurityRule,
+    ConsensusFloatRule, UnorderedIterationRule,
+)
+
+
+def default_rules() -> List[Rule]:
+    return [cls() for cls in ALL_RULES]

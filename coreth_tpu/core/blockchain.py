@@ -157,7 +157,9 @@ class BlockChain:
         # (Created before genesis setup: boot-time reads already join.)
         self.tail_error: Optional[str] = None
         self._tail_queue: "queue.Queue[Optional[tuple]]" = queue.Queue(2)
-        self._tail_snap_applied = threading.Event()
+        # the Event OBJECT is swapped per enqueued block; the swap races
+        # readers unless serialized with the insert path
+        self._tail_snap_applied = threading.Event()  # guarded-by: chainmu
         self._tail_snap_applied.set()
         self._tail_closed = False
         self._tail_thread = threading.Thread(
@@ -411,7 +413,9 @@ class BlockChain:
                 receipts, block.transactions, block_hash, number,
                 block.base_fee, Signer(self.config.chain_id),
             )
-        self._receipts[block_hash] = receipts
+        # cache insert under chainmu: every other _receipts write holds it
+        with self.chainmu:
+            self._receipts[block_hash] = receipts
         return receipts
 
     def has_block(self, block_hash: bytes) -> bool:
@@ -590,9 +594,10 @@ class BlockChain:
                 fn(block, logs)
 
     def _write_block(self, block: Block, receipts: List[Receipt],
-                     snap_update: Optional[tuple] = None) -> None:
+                     snap_update: Optional[tuple] = None) -> None:  # guarded-by: chainmu
         """Register the block in memory, then hand the disk tail (rawdb
-        writes + snapshot diff-layer attach) to the insert-tail worker."""
+        writes + snapshot diff-layer attach) to the insert-tail worker.
+        Caller holds chainmu (insert_block / reprocess paths)."""
         h = block.hash()
         self._blocks[h] = block
         self._receipts[h] = receipts
@@ -736,7 +741,11 @@ class BlockChain:
                     try:
                         tx.sender()  # caches the recovered sender
                     except Exception:
-                        pass
+                        # warm-path prefetch: the real read re-derives and
+                        # raises; count so a corrupt-history sweep is seen
+                        from ..metrics import count_drop
+
+                        count_drop("chain/warm/sender_recover_error")
             return blk
 
         healed = 0

@@ -53,6 +53,11 @@ class Pruner:
         try:
             n = must_decode_node(None, blob)
         except Exception:
+            # an undecodable account-trie node during mark = refs silently
+            # missed = live storage swept; make the skip visible
+            from ..metrics import count_drop
+
+            count_drop("core/pruner/account_node_decode_error")
             return
 
         def visit(node):
@@ -60,6 +65,9 @@ class Pruner:
                 try:
                     fields = rlp.decode(bytes(node.val))
                 except Exception:
+                    from ..metrics import count_drop
+
+                    count_drop("core/pruner/account_leaf_decode_error")
                     return
                 if isinstance(fields, list) and len(fields) >= 4:
                     storage_root = fields[2]

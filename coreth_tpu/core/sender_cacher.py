@@ -37,7 +37,10 @@ class TxSenderCacher:
             self._futures = [f for f in self._futures if not f.done()]
         if self._batch_recover is not None:
             fut = self._pool.submit(self._batch_recover, signer, txs)
-            self._futures.append(fut)
+            # under _lock: a concurrent wait() swaps the list out, and an
+            # unlocked append can land on the orphaned list and be lost
+            with self._lock:
+                self._futures.append(fut)
             return
 
         def work_batch(chunk):
@@ -59,13 +62,15 @@ class TxSenderCacher:
         if secp.available():
             # ONE native call: the C++ side threads internally; a strided
             # split would just multiply thread-spawn waves
-            self._futures.append(self._pool.submit(work_batch, txs))
+            futs = [self._pool.submit(work_batch, txs)]
         else:
             # pure-Python path: strided split like the reference
             # (sender_cacher.go:100-108) so the pool overlaps work
             n = min(self.threads, len(txs))
-            for i in range(n):
-                self._futures.append(self._pool.submit(work_batch, txs[i::n]))
+            futs = [self._pool.submit(work_batch, txs[i::n])
+                    for i in range(n)]
+        with self._lock:
+            self._futures.extend(futs)
 
     def recover_from_block(self, signer: Signer, block) -> None:
         self.recover(signer, block.transactions)

@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from .. import params
+from ..metrics import count_drop
 from .state_transition import intrinsic_gas
 from .types import Signer, Transaction
 
@@ -105,12 +106,16 @@ class TxJournal:
                 item, pos = rlp._decode_at(blob, pos)
                 tx = Transaction.decode(bytes(item))
             except Exception:
-                break  # truncated tail (crash mid-append): keep the rest
+                # truncated tail (crash mid-append): keep the rest
+                count_drop("txpool/journal/truncated")
+                break
             try:
                 add_fn(tx)
                 loaded += 1
             except Exception:
-                pass  # stale journal entries (already mined) are fine
+                # stale journal entries (already mined) are fine, but a
+                # journal full of rejects should show up in the counters
+                count_drop("txpool/journal/stale_entry")
         return loaded
 
     def insert(self, tx: Transaction) -> None:
@@ -254,7 +259,7 @@ class TxPool:
     # ------------------------------------------------------------ eviction
 
     def _evict_for(self, tx: Transaction, partition: Dict[bytes, "_TxList"],
-                   heap: "_PricedList") -> bool:
+                   heap: "_PricedList") -> bool:  # guarded-by: mu
         """Partition overflow: drop that partition's cheapest REMOTE tx if
         [tx] outbids it (txpool.go pricedList.Discard). Each partition has
         its own heap (txs re-push when they move partitions), so occupancy
@@ -278,7 +283,7 @@ class TxPool:
         self._remove(victim.hash())
         return True
 
-    def _remove(self, tx_hash: bytes) -> None:
+    def _remove(self, tx_hash: bytes) -> None:  # guarded-by: mu
         """Drop one tx from whichever partition holds it; demote later
         pending nonces of the same sender back to the queue."""
         tx = self.all.pop(tx_hash, None)
@@ -417,7 +422,7 @@ class TxPool:
             for fn in self._tx_feed:
                 fn([tx])
 
-    def _promote(self, sender: bytes) -> None:
+    def _promote(self, sender: bytes) -> None:  # guarded-by: mu
         """Move now-sequential queued txs into pending."""
         qlist = self.queue.get(sender)
         if qlist is None:
@@ -501,6 +506,7 @@ class TxPool:
                         self.chain_config, new_head, new_head.time
                     )
                 except Exception:
+                    count_drop("txpool/reset/base_fee_estimate_error")
                     self.min_fee = None
             for addr in list(self.pending):
                 plist = self.pending[addr]

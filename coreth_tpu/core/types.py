@@ -269,7 +269,12 @@ class Signer:
                 try:
                     self.sender(tx)
                 except Exception:
-                    pass
+                    # invalid signature: left uncached on purpose so the
+                    # insert path surfaces the precise error — but count,
+                    # a malformed-signature flood must be visible here too
+                    from ..metrics import count_drop
+
+                    count_drop("core/sender_batch/recover_error")
             return
         items = []
         ok_idx = []
@@ -277,6 +282,9 @@ class Signer:
             try:
                 recid, protected = self._recid_of(tx)
             except Exception:
+                from ..metrics import count_drop
+
+                count_drop("core/sender_batch/recid_error")
                 continue
             items.append((self.sig_hash(tx, protected=protected),
                           recid, tx.r, tx.s))

@@ -479,7 +479,12 @@ class EthAPI:
             try:
                 out = [hb(a) for a in ext.accounts()]
             except Exception:
-                out = []  # daemon down: keystore accounts still serve
+                # daemon down: keystore accounts still serve (same
+                # countable signal as pendingTransactions)
+                from ..metrics import count_drop
+
+                count_drop("accounts/external/list_error")
+                out = []
         if self.b.keystore is None:
             return out
         seen = set(out)

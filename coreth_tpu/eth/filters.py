@@ -10,6 +10,7 @@ import uuid
 from typing import Callable, Dict, List, Optional
 
 from ..core.types import bloom_lookup
+from ..metrics import count_drop
 
 FILTER_TIMEOUT = 300.0  # 5 min deactivation like filter_system.go
 
@@ -85,6 +86,9 @@ class FilterSystem:
                     for l in self._filter_logs(logs, crit):
                         notify(l)
             except Exception:
+                # a throwing sink is unsubscribed, not retried — count the
+                # eviction so a flapping websocket shows up in metrics
+                count_drop("eth/filters/subscriber_evicted")
                 with self.lock:
                     self._subscribers.pop(sid, None)
 
@@ -101,6 +105,7 @@ class FilterSystem:
                 for t in txs:
                     notify(t.hash())
             except Exception:
+                count_drop("eth/filters/subscriber_evicted")
                 with self.lock:
                     self._subscribers.pop(sid, None)
 
@@ -133,7 +138,7 @@ class FilterSystem:
             self.filters[fid] = f
         return fid
 
-    def _expire_stale(self) -> None:
+    def _expire_stale(self) -> None:  # guarded-by: lock
         now = time.monotonic()
         for fid in [fid for fid, f in self.filters.items()
                     if now - f.last_poll > FILTER_TIMEOUT]:

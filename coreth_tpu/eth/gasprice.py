@@ -11,6 +11,7 @@ from typing import List, Optional
 
 from .. import params
 from ..consensus.dummy import estimate_next_base_fee
+from ..metrics import count_drop
 
 CHECK_BLOCKS = 20
 PERCENTILE = 60
@@ -56,6 +57,9 @@ class Oracle:
                     self.b.chain_config, head, head.time
                 )
             except Exception:
+                # estimator fault: serving the stale base fee keeps the
+                # endpoint up, but persistent fallback = stale quotes
+                count_drop("eth/gasprice/estimate_fallback")
                 next_base = head.base_fee or 0
             return tip + next_base
         return max(tip, params.LAUNCH_MIN_GAS_PRICE)
@@ -84,6 +88,7 @@ class Oracle:
             )
             base_fees.append(nxt)
         except Exception:
+            count_drop("eth/gasprice/fee_history_estimate_fallback")
             base_fees.append(base_fees[-1] if base_fees else 0)
         out = {
             "oldestBlock": hex(blocks[0].number) if blocks else "0x0",

@@ -1728,7 +1728,7 @@ class Interpreter:
                 return data
             raise vmerrs.RevertError(data)  # SIG_REVERT
 
-    def _run_fast(self, contract: Contract, input_: bytes) -> bytes:
+    def _run_fast(self, contract: Contract, input_: bytes) -> bytes:  # hot-path
         """The list-dispatch loop: same step semantics as _run_legacy —
         identical gas, refunds, tracer callbacks, and revert data — with
         the per-step table lookups folded into a pre-parsed instruction

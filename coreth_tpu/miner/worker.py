@@ -127,6 +127,11 @@ class Worker:
                     self.config, self.chain, evm, gp, statedb, header, tx, used_gas
                 )
             except Exception:
+                # unminable tx: reverted and skipped — the reference logs
+                # every commitTransaction failure; we count them
+                from ..metrics import count_drop
+
+                count_drop("miner/tx_apply_error")
                 statedb.revert_to_snapshot(snap)
                 ordered.pop()
                 continue

@@ -173,7 +173,7 @@ class CommitPlan:
                              self.root_pos)
         return root
 
-    def execute_cpu(self, threads: int = 1) -> bytes:
+    def execute_cpu(self, threads: int = 1) -> bytes:  # hot-path
         """Host execution (threaded keccak); returns the 32-byte root."""
         root = np.empty(32, dtype=np.uint8)
         self._lib.mpt_plan_execute_cpu(self._h, threads, None, root)
@@ -462,7 +462,7 @@ class IncrementalTrie:
         if h:
             self._lib.mpt_inc_free(h)
 
-    def update(self, items: Sequence[Tuple[bytes, bytes]]) -> int:
+    def update(self, items: Sequence[Tuple[bytes, bytes]]) -> int:  # hot-path
         """Apply (key32, value) updates; empty value deletes. Returns the
         number of keys that actually changed the trie."""
         n = len(items)
@@ -503,7 +503,7 @@ class IncrementalTrie:
         lib.mpt_inc_word_patches(h, dst, child, shift)
         return specs, flat_words, dst, child, shift, int(lib.mpt_inc_root_pos(h))
 
-    def commit_cpu(self, threads: int = 1) -> bytes:
+    def commit_cpu(self, threads: int = 1) -> bytes:  # hot-path
         """Incremental host commit; returns the 32-byte root."""
         self._pin_mode("host")
         with phase_timer("resident/phase/plan"):

@@ -192,7 +192,7 @@ class PlannedCommit:
     def run(self, specs: Sequence, flat_words: np.ndarray,
             dst_word: np.ndarray, child_lane: np.ndarray,
             shift: np.ndarray, root_pos: int,
-            want_digests: bool = False) -> Tuple[bytes, Optional[np.ndarray]]:
+            want_digests: bool = False) -> Tuple[bytes, Optional[np.ndarray]]:  # hot-path
         """Inputs from CommitPlan.export_words(). Returns (root32,
         dig uint32[G, 8] | None)."""
         from ..metrics import phase_timer

@@ -136,7 +136,9 @@ class RemoteVM:
         try:
             self.request("shutdown")
         except Exception:  # noqa: BLE001 — server may die before replying
-            pass
+            from ..metrics import count_drop
+
+            count_drop("plugin/client/shutdown_rpc_error")
         self.close()
 
     def close(self) -> None:

@@ -116,13 +116,22 @@ class _Hasher:
 
                     self._device = get_batch_keccak("auto")
                 except Exception:
+                    # no device hasher: permanent host fallback — one
+                    # countable event, not a silent capability loss
+                    from ..metrics import count_drop
+
+                    count_drop("precompile/keccak/device_resolve_error")
                     self._device = None
                 self._resolved = True
             if self._device is not None:
                 try:
                     return self._device(msgs)
                 except Exception:
-                    pass  # fall through to the host path
+                    # fall through to the host path; a wedged device
+                    # would otherwise look like a mere perf regression
+                    from ..metrics import count_drop
+
+                    count_drop("precompile/keccak/device_exec_fallback")
         return keccak256_batch(msgs, threads=0 if len(msgs) < 256 else 8)
 
 

@@ -145,8 +145,10 @@ class Tree:
         self.diskdb = diskdb
         self.triedb = triedb
         self.lock = threading.RLock()
-        self.block_layers: Dict[bytes, object] = {}
-        self.state_layers: Dict[bytes, Dict[bytes, object]] = {}
+        # the diff-layer stack: every structural mutation (register,
+        # unregister, re-parent, flatten) happens under self.lock
+        self.block_layers: Dict[bytes, object] = {}  # guarded-by: lock
+        self.state_layers: Dict[bytes, Dict[bytes, object]] = {}  # guarded-by: lock
         self._gen_thread: Optional[threading.Thread] = None
 
         stored_root = diskdb.get(SNAPSHOT_ROOT_KEY)
@@ -193,11 +195,11 @@ class Tree:
 
     # ------------------------------------------------------------ structure
 
-    def _register(self, layer) -> None:
+    def _register(self, layer) -> None:  # guarded-by: lock
         self.block_layers[layer.block_hash] = layer
         self.state_layers.setdefault(layer.root, {})[layer.block_hash] = layer
 
-    def _unregister(self, layer) -> None:
+    def _unregister(self, layer) -> None:  # guarded-by: lock
         self.block_layers.pop(layer.block_hash, None)
         by_root = self.state_layers.get(layer.root)
         if by_root is not None:

@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 from ..core import rawdb
+from ..metrics import count_drop
 from ..native import keccak256
 from ..trie.proof import prove
 from .messages import (
@@ -49,6 +50,9 @@ class LeafsRequestHandler:
         try:
             trie = self.triedb.open_trie(req.root)
         except Exception:
+            # empty response = "dont-have" on the wire; the peer retries
+            # elsewhere, but WE should know we're serving misses
+            count_drop("sync/handlers/leafs_open_error")
             return LeafsResponse()
 
         resp = self._try_snapshot(req, trie, limit, deadline)
@@ -75,6 +79,7 @@ class LeafsRequestHandler:
                 keys.append(k)
                 vals.append(v)
         except Exception:
+            count_drop("sync/handlers/leafs_iterate_error")
             return LeafsResponse()
 
         return self._respond(req, trie, keys, vals, more)
@@ -126,6 +131,9 @@ class LeafsRequestHandler:
         except SnapshotError:
             return None  # generating / stale: the trie is the truth
         except Exception:
+            # unexpected snapshot fault (not a lifecycle miss): the trie
+            # fallback hides it, the counter does not
+            count_drop("sync/handlers/snapshot_read_error")
             return None
         if more and not keys:
             # budget died before anything was collected: let the trie
@@ -157,6 +165,7 @@ class LeafsRequestHandler:
                 if st.hash() != req.root:
                     return None
         except Exception:
+            count_drop("sync/handlers/snapshot_proof_error")
             return None
         return resp
 

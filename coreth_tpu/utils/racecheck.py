@@ -9,10 +9,23 @@ any wall-clock OVERLAP of two guarded calls from different threads is
 recorded as a violation: if the owner's locks are correct, guarded
 mutators can never overlap no matter how hard tests hammer the object.
 
+Two modes:
+
+* `guard(obj, methods)` — overlap detection: any wall-clock overlap of
+  two guarded calls from different threads is a violation.
+* `require_lock(obj, methods, lock_attr)` — lock-ownership detection:
+  the named lock attribute is replaced with an owner-tracking proxy and
+  every guarded method asserts on entry that the CURRENT thread holds
+  that lock.  This is strictly stronger than overlap detection (it
+  catches a caller that never takes the lock even when no other thread
+  happens to be inside) and is the runtime twin of the static SA002
+  `# guarded-by:` annotations.
+
 Usage (tests/test_race_discipline.py):
 
     det = RaceDetector()
     det.guard(triedb, ["update", "commit", "dereference", "cap"])
+    det.require_lock(chain, ["_write_block"], "chainmu")
     ... run concurrent chain load ...
     assert det.violations == []
 """
@@ -22,6 +35,52 @@ from __future__ import annotations
 import functools
 import threading
 from typing import List
+
+
+class _OwnedLock:
+    """Proxy around a Lock/RLock that records which thread holds it.
+
+    Only the acquire/release surface is intercepted; everything else
+    delegates to the wrapped lock, so Conditions built on it and direct
+    `acquire(timeout=...)` callers keep working.  Reentrant acquisition
+    is counted so RLock owners stay owners until the outermost release.
+    """
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._owner: int | None = None
+        self._count = 0
+
+    def acquire(self, *a, **kw):
+        got = self._inner.acquire(*a, **kw)
+        if got:
+            self._owner = threading.get_ident()
+            self._count += 1
+        return got
+
+    def release(self):
+        if self._count > 0:
+            self._count -= 1
+            if self._count == 0:
+                self._owner = None
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def held_by_me(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
 
 
 class RaceDetector:
@@ -41,6 +100,33 @@ class RaceDetector:
         for name in methods:
             orig = getattr(obj, name)
             setattr(obj, name, self._wrap(group, name, orig))
+
+    def require_lock(self, obj, methods, lock_attr: str) -> None:
+        """Assert [obj].[lock_attr] is held by the calling thread on entry
+        to each of [methods].  The lock attribute is swapped for an
+        owner-tracking proxy (idempotent: re-wrapping reuses the proxy),
+        so the object's own `with self.<lock>` blocks keep working and
+        feed the ownership record."""
+        lock = getattr(obj, lock_attr)
+        if not isinstance(lock, _OwnedLock):
+            lock = _OwnedLock(lock)
+            setattr(obj, lock_attr, lock)
+        for name in methods:
+            orig = getattr(obj, name)
+            setattr(obj, name, self._wrap_owned(name, lock_attr, lock, orig))
+
+    def _wrap_owned(self, name, lock_attr, lock: _OwnedLock, fn):
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            if not lock.held_by_me():
+                with self._meta:
+                    self.violations.append(
+                        f"{name} entered by thread {threading.get_ident()} "
+                        f"without holding {lock_attr}"
+                    )
+            return fn(*a, **kw)
+
+        return wrapped
 
     def _wrap(self, group, name, fn):
         @functools.wraps(fn)

@@ -97,7 +97,9 @@ class VMBlock:
             except Exception:
                 # re-issue is best-effort (block.go Reject logs and moves
                 # on); the chain-level reject must still run
-                pass
+                from ..metrics import count_drop
+
+                count_drop("vm/block/reject_reissue_error")
         vm.blockchain.reject(self.eth_block)
         self.status = BlockStatus.REJECTED
         vm.forget_verified_block(self.id())

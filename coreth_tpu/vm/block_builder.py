@@ -62,8 +62,7 @@ class BlockBuilder:
         mempool = len(vm.mempool) if getattr(vm, "mempool", None) is not None else 0
         return pending > 0 or mempool > 0
 
-    def _mark_building(self) -> None:
-        # lock held
+    def _mark_building(self) -> None:  # guarded-by: lock
         if self.build_sent or self._shutdown:
             return  # engine already has an un-consumed notification
         if self._timer is not None:
@@ -75,12 +74,16 @@ class BlockBuilder:
             try:
                 notify()
             except Exception:
-                return  # engine channel full: the retry timer recovers
+                # engine channel full: the retry timer recovers, and the
+                # backpressure is countable
+                from ..metrics import count_drop
+
+                count_drop("vm/builder/engine_notify_error")
+                return
         self.build_sent = True
         self.notifications_sent += 1
 
-    def _set_timer(self) -> None:
-        # lock held
+    def _set_timer(self) -> None:  # guarded-by: lock
         if self._timer is not None:
             self._timer.cancel()
         if self._shutdown:

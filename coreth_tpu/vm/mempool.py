@@ -84,7 +84,7 @@ class Mempool:
             self._seq += 1
             self.pending_signal.set()
 
-    def _remove(self, tx_id: bytes) -> None:
+    def _remove(self, tx_id: bytes) -> None:  # guarded-by: mu
         tx = self.txs.pop(tx_id, None)
         self.prices.pop(tx_id, None)
         if tx is not None:
@@ -135,7 +135,7 @@ class Mempool:
                     if conflicting is not None:
                         self._discard(other, conflicting)
 
-    def _discard(self, tx_id: bytes, tx: Tx) -> None:
+    def _discard(self, tx_id: bytes, tx: Tx) -> None:  # guarded-by: mu
         self.discarded[tx_id] = tx
         while len(self.discarded) > DISCARDED_CACHE_SIZE:
             self.discarded.popitem(last=False)

@@ -321,7 +321,7 @@ class VM:
 
     # --- consensus callbacks (vm.go:696-851) ------------------------------
 
-    def _on_finalize_and_assemble(self, header, state, txs):
+    def _on_finalize_and_assemble(self, header, state, txs):  # guarded-by: lock
         """Pull atomic txs from the mempool into the block being built."""
         rules = self.chain_config.rules(header.number, header.time)
         batch = rules.is_apricot_phase5
@@ -337,6 +337,9 @@ class VM:
                 tx.semantic_verify(self, header.base_fee)
                 tx.evm_state_transfer(self, state)
             except Exception:
+                from ..metrics import count_drop
+
+                count_drop("vm/build/atomic_tx_invalid")
                 state.revert_to_snapshot(inner_snap)
                 self.mempool.remove_tx(tx)
                 continue
@@ -348,6 +351,9 @@ class VM:
                     contribution += contrib
                     ext_gas_used += gas
                 except Exception:
+                    from ..metrics import count_drop
+
+                    count_drop("vm/build/atomic_tx_fee_error")
                     state.revert_to_snapshot(inner_snap)
                     self.mempool.remove_tx(tx)
                     continue

@@ -76,6 +76,81 @@ def test_detector_catches_real_overlap():
     assert det.violations, "detector missed guaranteed overlaps"
 
 
+def test_lock_ownership_detector_fires_without_lock():
+    """Negative self-test for the ownership mode: a guarded method
+    entered without the named lock MUST be recorded, and another thread
+    holding the lock must not count as ownership — proving the chain
+    assertions below are live."""
+
+    class Guarded:
+        def __init__(self):
+            self.mu = threading.Lock()
+
+        def mutate(self):
+            pass
+
+    obj = Guarded()
+    det = RaceDetector()
+    det.require_lock(obj, ["mutate"], "mu")
+
+    obj.mutate()  # no lock held
+    assert len(det.violations) == 1, det.violations
+
+    with obj.mu:
+        obj.mutate()  # owner calling: clean
+    assert len(det.violations) == 1, det.violations
+
+    with obj.mu:  # held by MAIN thread while another thread enters
+        t = threading.Thread(target=obj.mutate)
+        t.start()
+        t.join()
+    assert len(det.violations) == 2, det.violations
+
+
+def test_insert_tail_and_snapshot_layers_hold_their_locks():
+    """Runtime twin of the SA002 `# guarded-by:` annotations: under real
+    insert/accept load with concurrent readers, the PR-2 insert-tail
+    handoff (`_write_block`) must always run with chainmu held, and
+    snapshot diff-layer (un)registration must always run under the tree
+    lock.  Unlike overlap detection this also catches a caller that
+    never takes the lock while no other thread happens to be inside."""
+    chain, blocks = build_chain_and_blocks()
+    det = RaceDetector()
+    det.require_lock(chain, ["_write_block"], "chainmu")
+    assert chain.snaps is not None, "snapshot tree disabled; test is vacuous"
+    det.require_lock(chain.snaps, ["_register", "_unregister"], "lock")
+
+    stop = threading.Event()
+    read_errors = []
+
+    def reader():
+        rng = random.Random(7)
+        while not stop.is_set():
+            try:
+                st = chain.state()
+                st.get_balance(ADDR)
+                chain.get_block_by_number(
+                    rng.randrange(0, chain.current_block.number + 1))
+            except Exception as e:  # noqa: BLE001
+                read_errors.append(repr(e))
+
+    readers = [threading.Thread(target=reader) for _ in range(3)]
+    for t in readers:
+        t.start()
+    try:
+        for b in blocks:
+            chain.insert_block(b)
+            chain.accept(b)
+        chain.drain_acceptor_queue()
+    finally:
+        stop.set()
+        for t in readers:
+            t.join()
+    assert det.violations == [], det.violations[:5]
+    assert not read_errors, read_errors[:3]
+    chain.stop()
+
+
 def test_triedb_mutators_never_overlap_under_concurrent_load():
     """The chain's locking discipline must serialize every TrieDatabase
     mutation even with concurrent readers hammering state — the race

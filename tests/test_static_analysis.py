@@ -1,0 +1,254 @@
+"""Tier-1 gate for the repo-native static analysis (ISSUE 4): every SA
+rule must fire on a known-bad fixture, stay quiet on the matching
+known-good fixture, and the repo itself must be clean modulo the
+checked-in, justified baseline.
+"""
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from coreth_tpu.analysis import run_repo
+from coreth_tpu.analysis.engine import BaselineError, Engine, load_baseline
+from coreth_tpu.analysis.rules import default_rules
+
+
+def findings(src, relpath="coreth_tpu/fixture.py"):
+    eng = Engine(default_rules())
+    return eng.check_source(textwrap.dedent(src), relpath)
+
+
+def rule_ids(src, relpath="coreth_tpu/fixture.py"):
+    return sorted({f.rule for f in findings(src, relpath)})
+
+
+# ---------------------------------------------------------------- SA001
+
+def test_sa001_fires_on_silent_broad_except():
+    src = """
+    def fetch(db, k):
+        try:
+            return db[k]
+        except Exception:
+            return None
+    """
+    out = [f for f in findings(src) if f.rule == "SA001"]
+    assert len(out) == 1
+    assert out[0].qualname == "fetch"
+
+
+@pytest.mark.parametrize("body", [
+    "raise",                                   # re-raise
+    "log.warning('boom: %s', e)",              # logs
+    "count_drop('fixture/fetch_error')",       # metrics counter
+    "metrics.errors.inc()",                    # metrics attr
+    "out['error'] = str(e)",                   # in-band error reply
+    "return Resp(error=str(e))",               # error kwarg reply
+])
+def test_sa001_quiet_when_handled(body):
+    src = f"""
+    def fetch(db, k, out, log, metrics, count_drop, Resp):
+        try:
+            return db[k]
+        except Exception as e:
+            {body}
+    """
+    assert [f for f in findings(src) if f.rule == "SA001"] == []
+
+
+def test_sa001_quiet_on_narrow_except():
+    src = """
+    def fetch(db, k):
+        try:
+            return db[k]
+        except KeyError:
+            return None
+    """
+    assert [f for f in findings(src) if f.rule == "SA001"] == []
+
+
+# ---------------------------------------------------------------- SA002
+
+def test_sa002_fires_on_annotated_attr_written_without_lock():
+    src = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self.mu = threading.Lock()
+            self.items = []  # guarded-by: mu
+
+        def ok(self):
+            with self.mu:
+                self.items.append(1)
+
+        def bad(self):
+            self.items.append(2)
+    """
+    out = [f for f in findings(src) if f.rule == "SA002"]
+    assert len(out) == 1
+    assert out[0].qualname == "Pool.bad"
+
+
+def test_sa002_fires_on_inconsistent_locking():
+    src = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self.mu = threading.Lock()
+            self.items = []
+
+        def locked_write(self):
+            with self.mu:
+                self.items = []
+
+        def unlocked_write(self):
+            self.items = [1]
+    """
+    out = [f for f in findings(src) if f.rule == "SA002"]
+    assert len(out) == 1
+    assert out[0].qualname == "Pool.unlocked_write"
+
+
+def test_sa002_quiet_when_discipline_holds():
+    src = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self.mu = threading.Lock()
+            self.items = []  # guarded-by: mu
+
+        def add(self, x):
+            with self.mu:
+                self.items.append(x)
+
+        def _drain(self):  # guarded-by: mu
+            self.items = []
+
+        def clear_locked(self):
+            self.items = []
+    """
+    assert [f for f in findings(src) if f.rule == "SA002"] == []
+
+
+# ---------------------------------------------------------------- SA003
+
+def test_sa003_fires_on_wallclock_in_hot_path():
+    src = """
+    import time
+
+    def step(vm):  # hot-path
+        t = time.time()
+        return t
+    """
+    out = [f for f in findings(src) if f.rule == "SA003"]
+    assert len(out) == 1
+
+
+def test_sa003_fires_on_random_and_ctypes_alloc():
+    src = """
+    import ctypes
+    import random
+
+    def step(vm):  # hot-path
+        x = random.random()
+        buf = ctypes.create_string_buffer(64)
+        return x, buf
+    """
+    out = [f for f in findings(src) if f.rule == "SA003"]
+    assert len(out) == 2
+
+
+def test_sa003_quiet_without_marker_and_on_clean_hot_fn():
+    cold = """
+    import time
+
+    def step(vm):
+        return time.time()
+    """
+    hot_clean = """
+    def step(vm):  # hot-path
+        return vm.pc + 1
+    """
+    assert [f for f in findings(cold) if f.rule == "SA003"] == []
+    assert [f for f in findings(hot_clean) if f.rule == "SA003"] == []
+
+
+# ---------------------------------------------------------------- SA004
+
+def test_sa004_fires_on_float_arithmetic_in_consensus_path():
+    src = """
+    def gas_cost(n):
+        return n * 1.5
+    """
+    out = [f for f in findings(src, "coreth_tpu/evm/gas.py")
+           if f.rule == "SA004"]
+    assert out
+
+
+def test_sa004_quiet_outside_consensus_paths_and_on_int_math():
+    floaty = """
+    def ema(x, prev):
+        return 0.9 * prev + 0.1 * x
+    """
+    inty = """
+    def gas_cost(n):
+        return (n * 3) // 2
+    """
+    assert [f for f in findings(floaty, "coreth_tpu/metrics/fixture.py")
+            if f.rule == "SA004"] == []
+    assert [f for f in findings(inty, "coreth_tpu/evm/gas.py")
+            if f.rule == "SA004"] == []
+
+
+# ---------------------------------------------------------------- SA005
+
+def test_sa005_fires_on_set_iteration_in_hashing_path():
+    src = """
+    def commit(dirty):
+        keys = set(dirty)
+        for k in keys:
+            yield k
+    """
+    out = [f for f in findings(src, "coreth_tpu/trie/fixture.py")
+           if f.rule == "SA005"]
+    assert out
+
+
+def test_sa005_quiet_on_sorted_iteration():
+    src = """
+    def commit(dirty):
+        for k in sorted(set(dirty)):
+            yield k
+    """
+    assert [f for f in findings(src, "coreth_tpu/trie/fixture.py")
+            if f.rule == "SA005"] == []
+
+
+# ------------------------------------------------------------ repo gate
+
+def test_repo_is_clean_modulo_baseline():
+    """THE tier-1 gate: zero findings outside the checked-in allowlist,
+    and no stale allowlist entries masking future regressions."""
+    new, _suppressed, unused, _baseline = run_repo()
+    assert new == [], "new findings:\n" + "\n".join(f.render() for f in new)
+    assert unused == [], f"stale baseline entries: {unused}"
+
+
+def test_baseline_requires_justifications(tmp_path):
+    bad = tmp_path / "baseline.txt"
+    bad.write_text("SA001 coreth_tpu/x.py:f\n")
+    with pytest.raises(BaselineError):
+        load_baseline(bad)
+
+
+def test_cli_exits_zero_on_clean_repo():
+    proc = subprocess.run(
+        [sys.executable, "-m", "coreth_tpu.analysis"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# One-shot lint runner: repo-native static analysis (always) + mypy over
+# the strict core subset (only when mypy is installed — the CI image may
+# not ship it).  Exits non-zero if any enabled stage fails.
+set -u
+cd "$(dirname "$0")/.."
+
+rc=0
+
+echo "== coreth_tpu.analysis (AST lint: SA001-SA005, baseline-gated) =="
+python -m coreth_tpu.analysis || rc=1
+
+echo
+if python -c "import mypy" >/dev/null 2>&1; then
+    echo "== mypy (strict core subset, mypy.ini) =="
+    python -m mypy --config-file mypy.ini || rc=1
+else
+    echo "== mypy: not installed; skipping (config checked in at mypy.ini) =="
+fi
+
+exit $rc
