@@ -151,7 +151,8 @@ def _block_insert_rate(resident: bool = False):
     for b in blocks:
         chain.insert_block(b)
     dt = time.perf_counter() - t0
-    chain.stop()
+    chain.stop()  # drains the write tail, so "write" stamps are final
+    _LAST_INSERT_INFO["flight"] = chain.flight_recorder.last()
     return n_txs, n_txs / dt
 
 
@@ -467,52 +468,41 @@ def bench_9():
         print(json.dumps({"config": 9, **out}), flush=True)
 
 
-_RESIDENT_PHASES = (
-    "resident/phase/commit", "resident/phase/plan", "resident/phase/export",
-    "resident/phase/scatter", "resident/phase/patch", "resident/phase/store",
-    "resident/phase/host_hash",
-)
 _PLAN_CACHE = ("resident/plan_cache/hits", "resident/plan_cache/misses")
-# execution-side attribution (PR 2): per-insert phase timers plus the
-# snapshot read-path counters — a config-10 regression names the phase
-_CHAIN_PHASES = (
-    "chain/phase/recover", "chain/phase/verify", "chain/phase/execute",
-    "chain/phase/validate", "chain/phase/commit", "chain/phase/write",
-)
 _SNAP_COUNTERS = (
     "state/snap/hits", "state/snap/misses", "state/snap/generating",
 )
 
 
-def _phase_snapshot():
-    from coreth_tpu.metrics import default_registry
-
-    snap = {p: default_registry.timer(p).total()
-            for p in _RESIDENT_PHASES + _CHAIN_PHASES}
-    snap.update({c: default_registry.counter(c).count()
-                 for c in _PLAN_CACHE + _SNAP_COUNTERS})
-    return snap
-
-
-def _phase_delta(before):
-    after = _phase_snapshot()
+def _flight_attribution(recs):
+    """Per-leg attribution aggregated from the chain's flight recorder —
+    the same per-block records debug_blockFlightRecord serves, summed
+    over the leg. Replaces the PR-2-era raw registry scrape: the records
+    are per-chain, so consecutive legs in one process can't bleed into
+    each other's deltas."""
+    phases: dict = {}
+    resident: dict = {}
+    counters: dict = {}
+    for rec in recs:
+        for k, v in rec.get("phases", {}).items():
+            phases[k] = phases.get(k, 0.0) + v
+        for k, v in rec.get("resident", {}).items():
+            resident[k] = resident.get(k, 0.0) + v
+        for k, v in rec.get("counters", {}).items():
+            counters[k] = counters.get(k, 0) + v
     out = {}
-    for p in _RESIDENT_PHASES:
-        d = after[p] - before[p]
-        if d > 0:
-            out[p.rsplit("/", 1)[1] + "_s"] = round(d, 4)
-    for p in _CHAIN_PHASES:
-        d = after[p] - before[p]
-        if d > 0:
-            out["chain_" + p.rsplit("/", 1)[1] + "_s"] = round(d, 4)
+    for k in sorted(resident):
+        if resident[k] > 0:
+            out[k + "_s"] = round(resident[k], 4)
+    for k in sorted(phases):
+        if phases[k] > 0:
+            out["chain_" + k + "_s"] = round(phases[k], 4)
     for c in _PLAN_CACHE:
-        d = after[c] - before[c]
-        if d > 0:
-            out["plan_cache_" + c.rsplit("/", 1)[1]] = int(d)
+        if counters.get(c, 0) > 0:
+            out["plan_cache_" + c.rsplit("/", 1)[1]] = int(counters[c])
     for c in _SNAP_COUNTERS:
-        d = after[c] - before[c]
-        if d > 0:
-            out["snap_" + c.rsplit("/", 1)[1]] = int(d)
+        if counters.get(c, 0) > 0:
+            out["snap_" + c.rsplit("/", 1)[1]] = int(counters[c])
     return out
 
 
@@ -522,21 +512,19 @@ def bench_10():
     workload as config 3; vs_baseline = resident / default). Reuses
     bench_3's default-leg measurement when it already ran this process
     (a whole-suite run would otherwise pay the 1k pure-Python signings
-    a third time). Each leg carries its per-phase attribution (the
-    resident/phase/* timers) so a regression names the phase that ate
-    the time instead of just the headline tx/s."""
+    a third time). Each leg carries its per-phase attribution summed
+    from the chain's flight recorder, so a regression names the phase
+    that ate the time instead of just the headline tx/s."""
     from coreth_tpu.native import default_cpu_threads
 
     try:
         # cold pass seeds the per-segment-shape jit compiles (persisted by
         # the compilation cache; a node restart reuses them) — the warm
         # pass is the steady-state number. Both are reported.
-        snap = _phase_snapshot()
         _, cold_rate = _block_insert_rate(resident=True)
-        cold_phases = _phase_delta(snap)
-        snap = _phase_snapshot()
+        cold_phases = _flight_attribution(_LAST_INSERT_INFO.get("flight", []))
         n_txs, res_rate = _block_insert_rate(resident=True)
-        warm_phases = _phase_delta(snap)
+        warm_phases = _flight_attribution(_LAST_INSERT_INFO.get("flight", []))
     except RuntimeError as e:
         print(json.dumps({"config": 10, "skipped": str(e)}), flush=True)
         return

@@ -6,8 +6,9 @@ SA002 lock-discipline  attributes written under `self.<lock>` (or
                        annotated `# guarded-by: <lock>`) must never be
                        mutated outside it
 SA003 hot-path-purity  `# hot-path` functions must not read wall-clock,
-                       draw randomness, or allocate ctypes buffers per
-                       call
+                       draw randomness, allocate ctypes buffers, or
+                       construct metrics/spans per call (only the gated
+                       phase_timer/expensive_timer/span helpers)
 SA004 consensus-float  no float arithmetic where bit-exactness is the
                        product: trie/, rlp, evm gas, state hashing
 SA005 unordered-iter   no set-order-dependent iteration feeding RLP or
@@ -346,6 +347,15 @@ WALLCLOCK_CALLS = {
 RANDOM_ROOTS = ("random.", "np.random.", "numpy.random.", "secrets.")
 CTYPES_ALLOC = {"ctypes.create_string_buffer", "ctypes.create_unicode_buffer",
                 "create_string_buffer", "create_unicode_buffer"}
+# Observability in a hot path must go through the gated helpers (they are
+# no-ops when tracing/metrics are off); constructing/looking-up a metric
+# or span object per call defeats the gate and allocates in the hot loop.
+OBSERVABILITY_ALLOWED = {"phase_timer", "expensive_timer", "span"}
+OBSERVABILITY_FLAGGED = {
+    "timer", "histogram", "meter", "get_or_register_timer",
+    "get_or_register_meter", "get_or_register_gauge", "Timer", "Histogram",
+    "Meter", "Span", "Tracer", "start_span",
+}
 
 
 class HotPathPurityRule(Rule):
@@ -396,6 +406,12 @@ class HotPathPurityRule(Rule):
         if name in CTYPES_ALLOC:
             return (f"allocates a ctypes buffer per call (`{name}`) — "
                     f"hoist it out of the hot loop")
+        last = name.rsplit(".", 1)[-1]
+        if last in OBSERVABILITY_FLAGGED and last not in OBSERVABILITY_ALLOWED:
+            return (f"constructs a metric/span per call (`{name}`) inside a "
+                    f"hot path — hoist the registry lookup to module scope, "
+                    f"or use the gated phase_timer/expensive_timer/span "
+                    f"helpers")
         return None
 
 

@@ -12,10 +12,13 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from .. import rlp
+from ..metrics.flight import FlightRecorder
+from ..metrics.spans import span as _span
 from ..state.database import Database
 from ..state.statedb import StateDB
 from . import rawdb
@@ -74,6 +77,52 @@ class CacheConfig:
     cpu_threads: int = 0
     # bloom-bit index section (bloom_indexer.go BloomBitsBlocks)
     bloom_section_size: int = 4096
+    # per-chain flight recorder: ring size of retained per-block phase
+    # records (metrics/flight.py; served by debug_blockFlightRecord)
+    flight_recorder_size: int = 64
+
+
+# counter/timer families snapshotted around each insert so the flight
+# record carries per-block deltas (snapshot + plan-cache hits, keccak
+# batching) rather than process-cumulative values
+_FLIGHT_COUNTERS = (
+    "state/snap/hits", "state/snap/misses", "state/snap/generating",
+    "resident/plan_cache/hits", "resident/plan_cache/misses",
+    "trie/keccak/batches", "trie/keccak/batch_msgs",
+)
+_FLIGHT_TIMERS = (
+    "resident/phase/commit", "resident/phase/plan", "resident/phase/export",
+    "resident/phase/scatter", "resident/phase/patch", "resident/phase/store",
+    "resident/phase/host_hash",
+)
+
+
+class _PhaseClock:
+    """Times one insert phase into three sinks at once: the cumulative
+    `chain/phase/<name>` registry timer (bench attribution), the
+    in-flight block's flight record, and — when tracing is on — a
+    `chain/<name>` span. One extra dict store and two monotonic reads
+    per phase over the old bare registry timer."""
+
+    __slots__ = ("_timer", "_phases", "_name", "_span", "_t0")
+
+    def __init__(self, name: str, phases: Dict[str, float], registry):
+        self._timer = registry.timer("chain/phase/" + name)
+        self._phases = phases
+        self._name = name
+
+    def __enter__(self):
+        self._span = _span("chain/" + self._name)
+        self._span.__enter__()
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dt = time.monotonic() - self._t0
+        self._timer.update(dt)
+        self._phases[self._name] = self._phases.get(self._name, 0.0) + dt
+        self._span.__exit__(exc_type, exc, tb)
+        return False
 
 
 class BlockValidator:
@@ -221,7 +270,16 @@ class BlockChain:
         # reportBlock keeps a similar bounded set)
         from collections import deque
 
+        # bad_blocks holds (block, reason, flight_record) — the record is
+        # the in-flight phase breakdown captured up to the failure point
+        # (None when the failure precedes any instrumented phase)
         self.bad_blocks = deque(maxlen=10)
+        # per-chain flight recorder (metrics/flight.py): last-N per-block
+        # phase/counter records, served by debug_blockFlightRecord
+        self.flight_recorder = FlightRecorder(cache_config.flight_recorder_size)
+        # record of the insert currently running under chainmu; read by
+        # _insert_checked to attach phase context to bad-block entries
+        self._insert_rec: Optional[dict] = None
         self.mirror = None
         # resident mode is a PRUNING policy (interval persistence): under
         # pruning=False the archive guarantee — every block's state on
@@ -511,12 +569,21 @@ class BlockChain:
             # earlier failure from the bounded ring (the newest reason
             # wins — it reflects the current chain state)
             h = block.hash()
-            for i, (b, _) in enumerate(self.bad_blocks):
+            for i, (b, _, _) in enumerate(self.bad_blocks):
                 if b.hash() == h:
                     del self.bad_blocks[i]
                     break
-            self.bad_blocks.append((block, f"{type(e).__name__}: {e}"))
+            # attach the in-flight record: phase timings up to the point
+            # of failure are exactly what an operator debugging a
+            # bad-root/gas-mismatch block needs
+            rec = self._insert_rec
+            if rec is not None and rec.get("hash") != h:
+                rec = None
+            self.bad_blocks.append(
+                (block, f"{type(e).__name__}: {e}", rec))
             raise
+        finally:
+            self._insert_rec = None
 
     def _insert_block(self, block: Block, writes: bool) -> None:
         from ..metrics import default_registry as _metrics
@@ -527,22 +594,67 @@ class BlockChain:
         if parent is None:
             raise ChainError("unknown ancestor")
 
+        # flight record for this insert: phases fill as the block moves
+        # through the pipeline; counter deltas are computed at the end
+        rec: dict = {
+            "number": block.number,
+            "hash": block.hash(),
+            "txs": len(block.transactions),
+            "gas_used": 0,
+            "phases": {},
+            "writes": writes,
+        }
+        self._insert_rec = rec  # single writer: inserts hold chainmu
+        counters0 = {n: _metrics.counter(n).count() for n in _FLIGHT_COUNTERS}
+        timers0 = {n: _metrics.timer(n).total() for n in _FLIGHT_TIMERS}
+        phases = rec["phases"]
+
+        insert_span = _span("chain/insert", number=block.number,
+                            txs=len(block.transactions))
+        insert_span.__enter__()
+        try:
+            self._insert_phases(block, header, parent, writes, rec, phases,
+                                insert_timer, _metrics)
+        except BaseException as e:
+            insert_span.__exit__(type(e), e, e.__traceback__)
+            raise
+        else:
+            insert_span.__exit__(None, None, None)
+        finally:
+            mirror = self.mirror
+            rec["host_mode"] = (bool(mirror.host_mode)
+                                if mirror is not None else None)
+            rec["counters"] = {
+                n: _metrics.counter(n).count() - counters0[n]
+                for n in _FLIGHT_COUNTERS
+            }
+            rec["resident"] = {
+                n.rsplit("/", 1)[1]: d
+                for n in _FLIGHT_TIMERS
+                if (d := _metrics.timer(n).total() - timers0[n]) > 0.0
+            }
+
+    def _insert_phases(self, block: Block, header: Header, parent: Header,
+                       writes: bool, rec: dict, phases: Dict[str, float],
+                       insert_timer, _metrics) -> None:
+        """Phase body of _insert_block (split so the flight-record
+        bookkeeping wraps it exactly once)."""
         # overlap sender ecrecover with verification (blockchain.go:1247)
         from .sender_cacher import sender_cacher
         from .types import Signer
 
-        with _metrics.timer("chain/phase/recover").time():
+        with _PhaseClock("recover", phases, _metrics):
             sender_cacher.recover(
                 Signer(self.config.chain_id), block.transactions)
 
-        with _metrics.timer("chain/phase/verify").time():
+        with _PhaseClock("verify", phases, _metrics):
             self.engine.verify_header(self.config, header, parent)
             self.validator.validate_body(block)
 
         # join the recovery batch before execution: losing the race means
         # re-deriving senders one-by-one mid-execute, which duplicates the
         # whole batch's work on small machines
-        with _metrics.timer("chain/phase/recover").time():
+        with _PhaseClock("recover", phases, _metrics):
             sender_cacher.wait()
 
         statedb = self.state_at(parent.root)
@@ -551,15 +663,16 @@ class BlockChain:
 
         try:
             with insert_timer.time():
-                with _metrics.timer("chain/phase/execute").time():
+                with _PhaseClock("execute", phases, _metrics):
                     receipts, logs, used_gas = self.processor.process(
                         block, parent, statedb)
-                with _metrics.timer("chain/phase/validate").time():
+                with _PhaseClock("validate", phases, _metrics):
                     self.validator.validate_state(
                         block, statedb, receipts, used_gas)
         finally:
             statedb.stop_prefetcher()
 
+        rec["gas_used"] = used_gas
         if not writes:
             return
 
@@ -572,7 +685,7 @@ class BlockChain:
         # block hashes key the snapshot diff layer (coreth CommitWithSnap).
         # The diff-layer attach itself is deferred to the insert-tail
         # worker along with the rawdb writes (see _tail_worker)
-        with _metrics.timer("chain/phase/commit").time():
+        with _PhaseClock("commit", phases, _metrics):
             root = statedb.commit(
                 self.config.is_eip158(header.number),
                 block_hash=block.hash(),
@@ -583,7 +696,10 @@ class BlockChain:
                 raise ChainError("commit root mismatch")
             self.trie_writer.insert_trie(block)
 
-        self._write_block(block, receipts, statedb._deferred_snap_update)
+        # committed inserts enter the ring; the async tail stamps `write`
+        self.flight_recorder.record(rec)
+        self._write_block(block, receipts, statedb._deferred_snap_update,
+                          rec=rec)
 
         # new tip if it extends the current preference; the chain feed only
         # fires for head changes — non-canonical siblings must not reset
@@ -594,10 +710,12 @@ class BlockChain:
                 fn(block, logs)
 
     def _write_block(self, block: Block, receipts: List[Receipt],
-                     snap_update: Optional[tuple] = None) -> None:  # guarded-by: chainmu
+                     snap_update: Optional[tuple] = None,
+                     rec: Optional[dict] = None) -> None:  # guarded-by: chainmu
         """Register the block in memory, then hand the disk tail (rawdb
         writes + snapshot diff-layer attach) to the insert-tail worker.
-        Caller holds chainmu (insert_block / reprocess paths)."""
+        Caller holds chainmu (insert_block / reprocess paths). [rec] is
+        the block's flight record; the worker stamps its `write` phase."""
         h = block.hash()
         self._blocks[h] = block
         self._receipts[h] = receipts
@@ -606,7 +724,7 @@ class BlockChain:
         # the trie fallback for one read
         ev = threading.Event()
         self._tail_snap_applied = ev
-        self._tail_queue.put((block, receipts, snap_update, ev))
+        self._tail_queue.put((block, receipts, snap_update, ev, rec))
 
     def _write_block_data(self, block: Block, receipts: List[Receipt]) -> None:
         """rawdb persistence for one inserted block (tail-worker body)."""
@@ -634,15 +752,21 @@ class BlockChain:
             if item is None:
                 self._tail_queue.task_done()
                 return
-            block, receipts, snap_update, snap_applied = item
+            block, receipts, snap_update, snap_applied, rec = item
             try:
-                with write_timer.time():
-                    if snap_update is not None:
-                        self.snaps.update(*snap_update)
-                    # layer attached: the next block's state_at can open
-                    # against it while we grind through the RLP encodes
-                    snap_applied.set()
-                    self._write_block_data(block, receipts)
+                t0 = time.monotonic()
+                with _span("chain/write", number=block.number):
+                    with write_timer.time():
+                        if snap_update is not None:
+                            self.snaps.update(*snap_update)
+                        # layer attached: the next block's state_at can open
+                        # against it while we grind through the RLP encodes
+                        snap_applied.set()
+                        self._write_block_data(block, receipts)
+                if rec is not None:
+                    # late stamp into the shared record dict: readers of
+                    # the flight ring see `write` once the tail lands
+                    rec["phases"]["write"] = time.monotonic() - t0
             except Exception:
                 import traceback
 
@@ -824,14 +948,16 @@ class BlockChain:
         """startAcceptor body (blockchain.go:563-611)."""
         from ..metrics import default_registry as _metrics
 
-        with _metrics.timer("chain/block/accepts").time():
-            # the accepted block's diff layer and rawdb rows must be down
-            # before flatten folds layers / tx lookups are written
-            self.join_tail()
-            if self.snaps is not None:
-                self.snaps.flatten(block.hash())
-            self.trie_writer.accept_trie(block)
+        with _span("chain/accept", number=block.number):
+            with _metrics.timer("chain/block/accepts").time():
+                # the accepted block's diff layer and rawdb rows must be
+                # down before flatten folds layers / tx lookups are written
+                self.join_tail()
+                if self.snaps is not None:
+                    self.snaps.flatten(block.hash())
+                self.trie_writer.accept_trie(block)
         _metrics.gauge("chain/head/accepted").update(block.number)
+        self.flight_recorder.mark_accepted(block.hash())
         self.bloom_indexer.add_block(block.number, block.header.bloom)
         for i, tx in enumerate(block.transactions):
             rawdb.write_tx_lookup(self.diskdb, tx.hash(), block.number)

@@ -16,6 +16,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
 from ..metrics import default_registry as _metrics
+from ..metrics.spans import span
 from .types import Signer, Transaction
 
 
@@ -45,7 +46,8 @@ class TxSenderCacher:
 
         def work_batch(chunk):
             try:
-                signer.sender_batch(chunk)  # native batched recovery
+                with span("chain/recover/batch", txs=len(chunk)):
+                    signer.sender_batch(chunk)  # native batched recovery
             except Exception:
                 for tx in chunk:
                     try:

@@ -10,6 +10,7 @@ from __future__ import annotations
 from typing import List, Optional, Tuple
 
 from ..evm.evm import EVM, BlockContext, Config, TxContext
+from ..metrics.spans import span
 from ..native import keccak256
 from .state_transition import GasPool, Message, apply_message, tx_as_message
 from .types import Block, Header, Receipt, Signer
@@ -105,21 +106,24 @@ class StateProcessor:
         block_ctx = new_block_context(header, self.chain)
         evm = EVM(block_ctx, TxContext(), statedb, self.config, vm_config or Config())
 
-        for i, tx in enumerate(block.transactions):
-            statedb.set_tx_context(tx.hash(), i)
-            try:
-                receipt = apply_transaction(
-                    self.config, self.chain, evm, gp, statedb, header, tx,
-                    used_gas, block.hash(),
-                )
-            except Exception as e:
-                raise ProcessorError(
-                    f"could not apply tx {i} [{tx.hash().hex()}]: {e}"
-                ) from e
-            receipts.append(receipt)
-            all_logs.extend(receipt.logs)
+        with span("chain/execute/txs", number=block.number,
+                  txs=len(block.transactions)):
+            for i, tx in enumerate(block.transactions):
+                statedb.set_tx_context(tx.hash(), i)
+                try:
+                    receipt = apply_transaction(
+                        self.config, self.chain, evm, gp, statedb, header, tx,
+                        used_gas, block.hash(),
+                    )
+                except Exception as e:
+                    raise ProcessorError(
+                        f"could not apply tx {i} [{tx.hash().hex()}]: {e}"
+                    ) from e
+                receipts.append(receipt)
+                all_logs.extend(receipt.logs)
 
         # engine finalize: atomic txs mutate state via callback + fee checks
-        self.engine.finalize(self.config, block, parent, statedb, receipts)
+        with span("chain/execute/finalize"):
+            self.engine.finalize(self.config, block, parent, statedb, receipts)
 
         return receipts, all_logs, used_gas[0]

@@ -530,8 +530,10 @@ class DebugAPI:
     def getBadBlocks(self) -> list:
         """debug_getBadBlocks (eth/api.go GetBadBlocks): blocks that
         recently FAILED insertion (bad root, gas mismatch, ...)."""
+        from ..metrics.flight import marshal_record
+
         out = []
-        for blk, reason in getattr(self.b.chain, "bad_blocks", []):
+        for blk, reason, rec in getattr(self.b.chain, "bad_blocks", []):
             out.append({
                 "hash": hb(blk.hash()),
                 "block": {"number": hx(blk.number),
@@ -539,6 +541,9 @@ class DebugAPI:
                           "parentHash": hb(blk.parent_hash)},
                 "rlp": hb(blk.encode()),
                 "reason": reason,
+                # phase breakdown captured up to the failure point (None
+                # when the failure preceded any instrumented phase)
+                "flightRecord": marshal_record(rec) if rec else None,
             })
         return out
 

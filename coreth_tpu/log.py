@@ -39,7 +39,9 @@ class _JSONFormatter(logging.Formatter):
         }
         if record.__dict__.get("ctx"):
             out.update(record.__dict__["ctx"])
-        return json.dumps(out)
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=repr)
 
 
 def init(level: str = "info", json_format: bool = False,
@@ -77,3 +79,24 @@ def get_logger(name: str = "") -> logging.Logger:
 def trace(logger: logging.Logger, msg: str, **ctx) -> None:
     if logger.isEnabledFor(TRACE):
         logger.log(TRACE, msg, extra={"ctx": ctx})
+
+
+def debug(logger: logging.Logger, msg: str, **ctx) -> None:
+    if logger.isEnabledFor(logging.DEBUG):
+        logger.log(logging.DEBUG, msg, extra={"ctx": ctx})
+
+
+def info(logger: logging.Logger, msg: str, **ctx) -> None:
+    if logger.isEnabledFor(logging.INFO):
+        logger.log(logging.INFO, msg, extra={"ctx": ctx})
+
+
+def warn(logger: logging.Logger, msg: str, **ctx) -> None:
+    if logger.isEnabledFor(logging.WARNING):
+        logger.log(logging.WARNING, msg, extra={"ctx": ctx})
+
+
+def error(logger: logging.Logger, msg: str, exc_info=None, **ctx) -> None:
+    if logger.isEnabledFor(logging.ERROR):
+        logger.log(logging.ERROR, msg, exc_info=exc_info,
+                   extra={"ctx": ctx})

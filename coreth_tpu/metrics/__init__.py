@@ -4,9 +4,10 @@ EnabledExpensive gate and Prometheus-style export)."""
 
 from __future__ import annotations
 
+import re
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 enabled = True
 enabled_expensive = False  # metrics.EnabledExpensive gate
@@ -36,12 +37,15 @@ class Counter:
 class Gauge:
     def __init__(self):
         self._v = 0.0
+        self._lock = threading.Lock()
 
     def update(self, v) -> None:
-        self._v = v
+        with self._lock:
+            self._v = v
 
     def value(self):
-        return self._v
+        with self._lock:
+            return self._v
 
 
 class Histogram:
@@ -51,11 +55,13 @@ class Histogram:
         self._samples: List[float] = []
         self._reservoir = reservoir
         self._count = 0
+        self._sum = 0.0
         self._lock = threading.Lock()
 
     def update(self, v: float) -> None:
         with self._lock:
             self._count += 1
+            self._sum += v
             if len(self._samples) < self._reservoir:
                 self._samples.append(v)
             else:
@@ -68,6 +74,12 @@ class Histogram:
     def count(self) -> int:
         return self._count
 
+    def sum(self) -> float:
+        """Exact cumulative sum across every update (survives reservoir
+        eviction, unlike mean()*count())."""
+        with self._lock:
+            return self._sum
+
     def mean(self) -> float:
         with self._lock:
             return sum(self._samples) / len(self._samples) if self._samples else 0.0
@@ -78,6 +90,14 @@ class Histogram:
                 return 0.0
             s = sorted(self._samples)
             return s[min(len(s) - 1, int(len(s) * p))]
+
+    def percentiles(self, ps) -> List[float]:
+        """Batch percentile query: one sort under one lock acquisition."""
+        with self._lock:
+            if not self._samples:
+                return [0.0 for _ in ps]
+            s = sorted(self._samples)
+            return [s[min(len(s) - 1, int(len(s) * p))] for p in ps]
 
 
 class Meter:
@@ -107,11 +127,13 @@ class Timer:
         self.hist = Histogram()
         self.meter = Meter()
         self._total = 0.0
+        self._lock = threading.Lock()
 
     def update(self, seconds: float) -> None:
         self.hist.update(seconds)
         self.meter.mark()
-        self._total += seconds
+        with self._lock:
+            self._total += seconds
 
     def time(self):
         timer = self
@@ -136,7 +158,42 @@ class Timer:
         """Exact cumulative seconds across every update (unlike
         mean()*count(), which drifts once the reservoir saturates) —
         what the bench phase-attribution report divides."""
-        return self._total
+        with self._lock:
+            return self._total
+
+
+# --- Prometheus exposition helpers ------------------------------------------
+
+# legal sample-name charset: [a-zA-Z_:][a-zA-Z0-9_:]*
+_NAME_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+# summary quantiles exported for every Timer/Histogram
+_QUANTILES = (0.5, 0.9, 0.99)
+_QUANTILE_LABELS = ("0.5", "0.9", "0.99")
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Registry names use `/` and `.` separators (go-metrics style); the
+    exposition needs `[a-zA-Z_:][a-zA-Z0-9_:]*`."""
+    out = _NAME_SANITIZE_RE.sub("_", name)
+    if not out or not (out[0].isalpha() or out[0] in "_:"):
+        out = "_" + out
+    return out
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    return repr(f)
 
 
 class Registry:
@@ -174,24 +231,83 @@ class Registry:
             return list(self._metrics.items())
 
     def export_prometheus(self) -> str:
-        """Text exposition (the avalanchego gatherer analog)."""
-        lines = []
-        for name, m in self.each():
-            metric_name = name.replace("/", "_").replace(".", "_")
+        """Full text exposition (the avalanchego gatherer analog): every
+        family gets `# HELP`/`# TYPE` lines, Timer/Histogram export as
+        Prometheus summaries (p50/p90/p99 quantiles + exact `_sum` and
+        `_count`), and names are sanitized to the legal charset. The
+        output parses under any Prometheus scraper; `python -m
+        coreth_tpu.metrics --check` validates it in CI."""
+        lines: List[str] = []
+
+        def family(fam: str, kind: str, help_text: str,
+                   samples: List[Tuple[str, tuple, object]]) -> None:
+            lines.append(f"# HELP {fam} {help_text}")
+            lines.append(f"# TYPE {fam} {kind}")
+            for sname, labels, value in samples:
+                if labels:
+                    lab = ",".join(f'{k}="{v}"' for k, v in labels)
+                    lines.append(f"{sname}{{{lab}}} {_fmt_value(value)}")
+                else:
+                    lines.append(f"{sname} {_fmt_value(value)}")
+
+        def summary(fam: str, help_text: str, quantiles: List[float],
+                    total: float, count: int) -> None:
+            samples: List[Tuple[str, tuple, object]] = [
+                (fam, (("quantile", _QUANTILE_LABELS[i]),), q)
+                for i, q in enumerate(quantiles)
+            ]
+            samples.append((fam + "_sum", (), total))
+            samples.append((fam + "_count", (), count))
+            family(fam, "summary", help_text, samples)
+
+        for name, m in sorted(self.each()):
+            fam = sanitize_metric_name(name)
             if isinstance(m, Counter):
-                lines.append(f"{metric_name} {m.count()}")
+                family(fam, "counter", f"coreth_tpu counter {name}",
+                       [(fam, (), m.count())])
             elif isinstance(m, Gauge):
-                lines.append(f"{metric_name} {m.value()}")
+                family(fam, "gauge", f"coreth_tpu gauge {name}",
+                       [(fam, (), m.value())])
             elif isinstance(m, Meter):
-                lines.append(f"{metric_name}_total {m.count()}")
-                lines.append(f"{metric_name}_rate {m.rate_mean():.6f}")
-            elif isinstance(m, Histogram):
-                lines.append(f"{metric_name}_count {m.count()}")
-                lines.append(f"{metric_name}_mean {m.mean():.6f}")
+                family(fam + "_total", "counter",
+                       f"coreth_tpu meter {name} (event count)",
+                       [(fam + "_total", (), m.count())])
+                family(fam + "_rate", "gauge",
+                       f"coreth_tpu meter {name} (events/sec)",
+                       [(fam + "_rate", (), m.rate_mean())])
             elif isinstance(m, Timer):
-                lines.append(f"{metric_name}_count {m.count()}")
-                lines.append(f"{metric_name}_mean_seconds {m.mean():.6f}")
+                summary(fam + "_seconds",
+                        f"coreth_tpu timer {name} (seconds)",
+                        m.hist.percentiles(_QUANTILES), m.total(), m.count())
+            elif isinstance(m, Histogram):
+                summary(fam, f"coreth_tpu histogram {name}",
+                        m.percentiles(_QUANTILES), m.sum(), m.count())
         return "\n".join(lines) + "\n"
+
+    def marshal(self) -> Dict[str, dict]:
+        """JSON-friendly dump of every metric — the `debug_metrics` RPC
+        payload (go-ethereum's debug/metrics.go analog)."""
+        out: Dict[str, dict] = {}
+        for name, m in sorted(self.each()):
+            if isinstance(m, Counter):
+                out[name] = {"type": "counter", "count": m.count()}
+            elif isinstance(m, Gauge):
+                out[name] = {"type": "gauge", "value": m.value()}
+            elif isinstance(m, Meter):
+                out[name] = {"type": "meter", "count": m.count(),
+                             "rate": m.rate_mean()}
+            elif isinstance(m, Timer):
+                p50, p90, p99 = m.hist.percentiles(_QUANTILES)
+                out[name] = {"type": "timer", "count": m.count(),
+                             "total_seconds": m.total(),
+                             "mean_seconds": m.mean(),
+                             "p50": p50, "p90": p90, "p99": p99}
+            elif isinstance(m, Histogram):
+                p50, p90, p99 = m.percentiles(_QUANTILES)
+                out[name] = {"type": "histogram", "count": m.count(),
+                             "sum": m.sum(), "mean": m.mean(),
+                             "p50": p50, "p90": p90, "p99": p99}
+        return out
 
 
 # default registry (metrics.DefaultRegistry)

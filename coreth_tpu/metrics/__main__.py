@@ -1,0 +1,205 @@
+"""Exposition self-check CLI.
+
+    python -m coreth_tpu.metrics            # print the live exposition
+    python -m coreth_tpu.metrics --json     # debug_metrics-shaped JSON
+    python -m coreth_tpu.metrics --check    # validate and exit 0/1
+
+`--check` runs in tools/lint.sh: it builds a synthetic registry that
+exercises every metric type (plus hostile names) AND the process
+default registry, then validates both expositions line-by-line —
+malformed metric registrations fail CI instead of breaking the scraper.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from . import Registry, default_registry
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{([^}]*)\})?\s+(\S+)(?:\s+(-?\d+))?$")
+_LABEL_RE = re.compile(r'^([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"$')
+_HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) (.*)$")
+_TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+                      r"(counter|gauge|summary|histogram|untyped)$")
+
+# suffixes a sample may add to its family name, by family type
+_FAMILY_SUFFIXES = {
+    "summary": ("", "_sum", "_count"),
+    "histogram": ("", "_sum", "_count", "_bucket"),
+    "counter": ("",),
+    "gauge": ("",),
+    "untyped": ("",),
+}
+
+
+def _parse_value(raw: str) -> Optional[float]:
+    if raw in ("+Inf", "-Inf", "NaN", "Nan", "nan"):
+        return {"+Inf": math.inf, "-Inf": -math.inf}.get(raw, math.nan)
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def validate_exposition(text: str) -> List[str]:
+    """Validate a Prometheus text exposition. Returns a list of error
+    strings (empty = valid). Checks: every line parses, metric/label
+    names are legal, HELP/TYPE declared once per family and before its
+    samples, every sample belongs to a declared family, summary
+    quantiles are float labels with monotone values, summaries carry
+    _sum and _count, counters are finite and non-negative."""
+    errors: List[str] = []
+    types: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    # family -> [(quantile, value)] for monotonicity; family -> suffixes seen
+    quantiles: Dict[str, List[Tuple[float, float]]] = {}
+    suffixes_seen: Dict[str, set] = {}
+
+    def owning_family(sample: str) -> Optional[Tuple[str, str]]:
+        best = None
+        for fam, kind in types.items():
+            for sfx in _FAMILY_SUFFIXES[kind]:
+                if sample == fam + sfx:
+                    if best is None or len(fam) > len(best[0]):
+                        best = (fam, sfx)
+        return best
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            mh = _HELP_RE.match(line)
+            mt = _TYPE_RE.match(line)
+            if mh:
+                fam = mh.group(1)
+                if fam in helps:
+                    errors.append(f"line {lineno}: duplicate HELP for {fam}")
+                helps[fam] = mh.group(2)
+            elif mt:
+                fam = mt.group(1)
+                if fam in types:
+                    errors.append(f"line {lineno}: duplicate TYPE for {fam}")
+                types[fam] = mt.group(2)
+            elif line.startswith("# HELP") or line.startswith("# TYPE"):
+                errors.append(f"line {lineno}: malformed HELP/TYPE: {line!r}")
+            continue  # other comments are legal
+
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            errors.append(f"line {lineno}: unparseable sample: {line!r}")
+            continue
+        name, rawlabels, rawvalue = m.group(1), m.group(2), m.group(3)
+        value = _parse_value(rawvalue)
+        if value is None:
+            errors.append(f"line {lineno}: bad value {rawvalue!r} for {name}")
+            continue
+        labels: Dict[str, str] = {}
+        if rawlabels:
+            for part in rawlabels.split(","):
+                lm = _LABEL_RE.match(part.strip())
+                if not lm:
+                    errors.append(
+                        f"line {lineno}: bad label {part!r} on {name}")
+                    continue
+                labels[lm.group(1)] = lm.group(2)
+
+        owner = owning_family(name)
+        if owner is None:
+            errors.append(
+                f"line {lineno}: sample {name} has no preceding # TYPE")
+            continue
+        fam, sfx = owner
+        kind = types[fam]
+        suffixes_seen.setdefault(fam, set()).add(sfx)
+        if kind == "counter" and not (value >= 0 and math.isfinite(value)):
+            errors.append(
+                f"line {lineno}: counter {name} value {rawvalue} invalid")
+        if kind == "summary" and sfx == "":
+            q = labels.get("quantile")
+            if q is None:
+                errors.append(
+                    f"line {lineno}: summary sample {name} missing quantile")
+            else:
+                try:
+                    quantiles.setdefault(fam, []).append((float(q), value))
+                except ValueError:
+                    errors.append(
+                        f"line {lineno}: bad quantile {q!r} on {name}")
+
+    for fam, kind in types.items():
+        if fam not in helps:
+            errors.append(f"family {fam}: TYPE without HELP")
+        if kind == "summary" and fam in suffixes_seen:
+            for want in ("_sum", "_count"):
+                if want not in suffixes_seen[fam]:
+                    errors.append(f"summary {fam}: missing {fam}{want}")
+    for fam, qs in quantiles.items():
+        ordered = sorted(qs)
+        values = [v for _, v in ordered]
+        if any(b < a for a, b in zip(values, values[1:])):
+            errors.append(f"summary {fam}: quantile values not monotone: "
+                          f"{ordered}")
+    return errors
+
+
+def _synthetic_registry() -> Registry:
+    """Exercise every metric type, including names the sanitizer must
+    rewrite, so --check proves the whole exposition path."""
+    r = Registry()
+    r.counter("chain/blocks/inserted").inc(7)
+    r.counter("9starts/with-digit").inc(1)
+    r.gauge("chain/head.height").update(42)
+    r.gauge("resident/fill+ratio").update(0.75)
+    r.meter("chain/txs").mark(1000)
+    h = r.histogram("trie/keccak/batch_msgs")
+    for i in range(500):
+        h.update(float(i))
+    t = r.timer("chain/phase/verify")
+    for i in range(200):
+        t.update(0.001 * (i + 1))
+    r.timer("chain/phase/empty")  # registered but never updated
+    return r
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m coreth_tpu.metrics",
+        description="Prometheus exposition printer / self-check")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the exposition (synthetic + live "
+                         "registry) and exit non-zero on any error")
+    ap.add_argument("--json", action="store_true",
+                    help="print the debug_metrics JSON marshal instead")
+    args = ap.parse_args(argv)
+
+    if args.check:
+        failed = False
+        for label, reg in (("synthetic", _synthetic_registry()),
+                           ("default", default_registry)):
+            errs = validate_exposition(reg.export_prometheus())
+            if errs:
+                failed = True
+                print(f"[metrics --check] {label} registry: "
+                      f"{len(errs)} error(s)")
+                for e in errs:
+                    print(f"  {e}")
+            else:
+                print(f"[metrics --check] {label} registry: OK")
+        return 1 if failed else 0
+
+    if args.json:
+        print(json.dumps(default_registry.marshal(), indent=2, sort_keys=True))
+        return 0
+
+    sys.stdout.write(default_registry.export_prometheus())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,102 @@
+"""Per-block flight recorder: a bounded ring of the last N block-insert
+records, always on (the cost is a handful of clock reads and counter
+snapshots per block — noise next to execution/commit).
+
+Each record is a plain dict built by core/blockchain during insert:
+
+    {"number": int, "hash": bytes, "txs": int, "gas_used": int,
+     "phases": {"recover"|"verify"|"execute"|"validate"|"commit"|"write":
+                seconds, ...},
+     "resident": {phase: seconds, ...},      # resident/phase/* deltas
+     "counters": {name: delta, ...},         # snap + plan-cache + keccak
+     "host_mode": bool | None,               # device vs host hashing
+     "accepted": bool, "seq": int}
+
+The `write` phase is stamped asynchronously by the overlapped insert
+tail; records are shared dicts, so readers see it once the tail worker
+lands. On verify/execute failure the in-flight record is attached to the
+chain's `bad_blocks` ring instead, and `debug_blockFlightRecord` serves
+the accepted view over RPC.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+DEFAULT_CAPACITY = 64
+
+
+class FlightRecorder:
+    """Lock-guarded bounded ring of per-block records. One instance per
+    BlockChain (NOT process-global) so tests and multi-VM processes
+    don't bleed into each other."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._ring: deque = deque(maxlen=max(1, int(capacity)))
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def record(self, rec: Dict[str, object]) -> Dict[str, object]:
+        """Append one block record (mutated in place later for the async
+        `write` phase and the accept mark). Returns the same dict."""
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            rec.setdefault("accepted", False)
+            self._ring.append(rec)
+        return rec
+
+    def mark_accepted(self, block_hash: bytes) -> None:
+        """Flip `accepted` on the record for this hash (newest match)."""
+        with self._lock:
+            for rec in reversed(self._ring):
+                if rec.get("hash") == block_hash:
+                    rec["accepted"] = True
+                    return
+
+    def last(self, n: Optional[int] = None,
+             accepted_only: bool = False) -> List[Dict[str, object]]:
+        """Newest-last list of the most recent records. The dicts are the
+        live ones (so late `write` stamps show up); callers that marshal
+        should copy."""
+        with self._lock:
+            recs = list(self._ring)
+        if accepted_only:
+            recs = [r for r in recs if r.get("accepted")]
+        if n is not None:
+            recs = recs[-max(0, int(n)):]
+        return recs
+
+    def find(self, block_hash: bytes) -> Optional[Dict[str, object]]:
+        with self._lock:
+            for rec in reversed(self._ring):
+                if rec.get("hash") == block_hash:
+                    return rec
+        return None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def capacity(self) -> int:
+        with self._lock:
+            return self._ring.maxlen or 0
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+def marshal_record(rec: Dict[str, object]) -> Dict[str, object]:
+    """JSON-safe copy of one record (bytes hash → 0x-hex) — shared by
+    debug_blockFlightRecord and debug_getBadBlocks."""
+    out = dict(rec)
+    h = out.get("hash")
+    if isinstance(h, (bytes, bytearray)):
+        out["hash"] = "0x" + bytes(h).hex()
+    for k in ("phases", "counters", "resident"):
+        if isinstance(out.get(k), dict):
+            out[k] = dict(out[k])
+    return out

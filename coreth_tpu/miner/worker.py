@@ -77,6 +77,12 @@ class Worker:
 
     def commit_new_work(self, pending: Optional[Dict[bytes, List[Transaction]]] = None) -> Block:
         """commitNewWork (worker.go:118-195) → assembled block."""
+        from ..metrics.spans import span
+
+        with span("miner/build"):
+            return self._commit_new_work(pending)
+
+    def _commit_new_work(self, pending: Optional[Dict[bytes, List[Transaction]]] = None) -> Block:
         parent = self.chain.current_block
         timestamp = max(self.clock(), parent.time)
 

@@ -85,6 +85,9 @@ class PlannedModeKeccak:
         self._digests = digests
 
     def __call__(self, msgs):
+        from ..trie.hasher import count_keccak_batch
+
+        count_keccak_batch(len(msgs))
         return self._digests(msgs)
 
 
@@ -100,4 +103,7 @@ class FusedModeKeccak:
         self._digests = digests
 
     def __call__(self, msgs):
+        from ..trie.hasher import count_keccak_batch
+
+        count_keccak_batch(len(msgs))
         return self._digests(msgs)

@@ -98,10 +98,13 @@ class RPCServer:
                 req_id, METHOD_NOT_FOUND, f"the method {method} does not exist"
             )
         try:
-            if isinstance(params, dict):
-                result = fn(**params)
-            else:
-                result = fn(*params)
+            from ..metrics.spans import span
+
+            with span("rpc/" + method):
+                if isinstance(params, dict):
+                    result = fn(**params)
+                else:
+                    result = fn(*params)
         except RPCError as e:
             return self._encode_error(req_id, e.code, str(e), e.data)
         except TypeError as e:

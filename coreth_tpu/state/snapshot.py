@@ -383,6 +383,13 @@ class Tree:
     def _generate(self, root: bytes) -> None:
         """Build the disk layer from the state trie (generate.go, run
         synchronously; the async path wraps this in a thread)."""
+        from ..metrics.spans import span
+        from ..trie.node import EMPTY_ROOT
+
+        with span("snapshot/generate", root=root.hex()[:12]):
+            self._generate_inner(root)
+
+    def _generate_inner(self, root: bytes) -> None:
         from ..trie.node import EMPTY_ROOT
 
         batch = self.diskdb.new_batch()

@@ -15,6 +15,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from .. import rlp
 from ..metrics import default_registry as _metrics
+from ..metrics import spans as _spans
 from ..native import keccak256
 from ..core import rawdb
 from ..trie.node import EMPTY_ROOT
@@ -460,7 +461,8 @@ class StateDB:
             from ..trie.hasher import BATCH_THRESHOLD
 
             if est >= BATCH_THRESHOLD:
-                self._batch_storage_roots()
+                with _spans.span("state/hash_plan/storage", est=est):
+                    self._batch_storage_roots()
         # default mode: the planned graph builder walks Python account-
         # trie nodes (which a resident StateDB doesn't have), hashing
         # storage tries AND the account trie in one program
@@ -473,7 +475,8 @@ class StateDB:
             from ..trie.hasher import BATCH_THRESHOLD
 
             if est >= BATCH_THRESHOLD:
-                return self._planned_intermediate_root()
+                with _spans.span("state/hash_plan/planned", est=est):
+                    return self._planned_intermediate_root()
         with expensive_timer("state/account/updates"):
             for addr in sorted(self._objects_pending):
                 obj = self._objects[addr]

@@ -13,6 +13,7 @@ from typing import List, Optional
 
 from ..core import rawdb
 from ..metrics import count_drop
+from ..metrics.spans import span
 from ..native import keccak256
 from ..trie.proof import prove
 from .messages import (
@@ -230,9 +231,12 @@ class SyncHandler:
     def handle(self, sender: bytes, request: bytes) -> bytes:
         msg = decode_message(request)
         if isinstance(msg, LeafsRequest):
-            return self.leafs.on_leafs_request(msg).encode()
+            with span("sync/leafs", limit=msg.limit or 0):
+                return self.leafs.on_leafs_request(msg).encode()
         if isinstance(msg, BlockRequest):
-            return self.blocks.on_block_request(msg).encode()
+            with span("sync/blocks", parents=msg.parents):
+                return self.blocks.on_block_request(msg).encode()
         if isinstance(msg, CodeRequest):
-            return self.code.on_code_request(msg).encode()
+            with span("sync/code", hashes=len(msg.hashes)):
+                return self.code.on_code_request(msg).encode()
         raise ValueError(f"unhandled request {type(msg)}")

@@ -156,17 +156,19 @@ class ResidentAccountMirror:
         takeover and the SAME commit completes on the CPU, so callers
         never see the failure (the chain does not stall)."""
         from ..metrics import phase_timer
+        from ..metrics.spans import span
         from ..native.mpt import DeviceWedgedError
 
-        with phase_timer("resident/phase/commit"):
-            if self.host_mode:
-                return self.trie.commit_cpu(threads=self._cpu_threads)
-            try:
-                return self.trie.commit_resident_timed(
-                    self.ex, self.device_timeout)
-            except DeviceWedgedError as e:
-                self._take_over_host(str(e))
-                return self.trie.commit_cpu(threads=self._cpu_threads)
+        with span("resident/commit", host_mode=self.host_mode):
+            with phase_timer("resident/phase/commit"):
+                if self.host_mode:
+                    return self.trie.commit_cpu(threads=self._cpu_threads)
+                try:
+                    return self.trie.commit_resident_timed(
+                        self.ex, self.device_timeout)
+                except DeviceWedgedError as e:
+                    self._take_over_host(str(e))
+                    return self.trie.commit_cpu(threads=self._cpu_threads)
 
     def _take_over_host(self, why: str) -> None:
         """One-way device -> host switch: rebuild the full host digest

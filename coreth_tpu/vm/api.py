@@ -497,6 +497,56 @@ def health_check(vm) -> dict:
     }
 
 
+class DebugMetricsAPI:
+    """Observability half of the debug namespace (go-ethereum's
+    debug/metrics.go Metrics + the flight-recorder/span surface this repo
+    adds). Registered alongside the tracing DebugAPI under the same
+    eth-apis gate."""
+
+    def __init__(self, vm):
+        self.vm = vm
+
+    def metrics(self) -> dict:
+        """debug_metrics: JSON dump of every registered metric."""
+        from ..metrics import default_registry
+
+        return default_registry.marshal()
+
+    def blockFlightRecord(self, n: Optional[int] = None,
+                          accepted_only: bool = True) -> list:
+        """debug_blockFlightRecord: per-phase timings + counter deltas
+        for the last N accepted blocks (accepted_only=False includes
+        inserted-but-not-yet-accepted blocks)."""
+        from ..metrics.flight import marshal_record
+
+        recs = self.vm.blockchain.flight_recorder.last(
+            n=n, accepted_only=accepted_only)
+        return [marshal_record(r) for r in recs]
+
+    def spanDump(self, clear: bool = False) -> dict:
+        """debug_spanDump: finished spans as Chrome trace-event JSON
+        (load the result straight into Perfetto)."""
+        from ..metrics.spans import tracer
+
+        return tracer.chrome_trace(clear=bool(clear))
+
+    def setSpans(self, enabled: bool) -> bool:
+        """debug_setSpans: toggle span collection process-wide at
+        runtime; returns the new state."""
+        from ..metrics import spans
+
+        spans.set_enabled(bool(enabled))
+        return spans.enabled
+
+    def setExpensiveMetrics(self, enabled: bool) -> bool:
+        """debug_setExpensiveMetrics: flip the EnabledExpensive gate
+        process-wide at runtime; returns the new state."""
+        from .. import metrics as _metrics
+
+        _metrics.enabled_expensive = bool(enabled)
+        return _metrics.enabled_expensive
+
+
 def create_handlers(vm, allow_unfinalized_queries: bool = False) -> RPCServer:
     """CreateHandlers (vm.go:1138): the full RPC surface on one server,
     namespace-gated by the eth-apis config list (config.go eth-apis,
@@ -536,6 +586,7 @@ def create_handlers(vm, allow_unfinalized_queries: bool = False) -> RPCServer:
         server.register_api("personal", PersonalAPI(backend))
     if apis & {"debug", "internal-debug", "debug-tracer"}:
         server.register_api("debug", DebugAPI(backend))
+        server.register_api("debug", DebugMetricsAPI(vm))
     if apis & {"txpool", "internal-tx-pool"}:
         server.register_api("txpool", TxPoolAPI(backend))
     if "net" in apis:

@@ -82,6 +82,9 @@ class BlockBuilder:
                 return
         self.build_sent = True
         self.notifications_sent += 1
+        from ..metrics import default_registry
+
+        default_registry.counter("vm/builder/notifications").inc()
 
     def _set_timer(self) -> None:  # guarded-by: lock
         if self._timer is not None:

@@ -103,8 +103,21 @@ class Config:
     continuous_profiler_frequency: float = 900.0
     continuous_profiler_max_files: int = 5
 
-    # --- metrics ----------------------------------------------------------
+    # --- metrics / observability -----------------------------------------
     metrics_expensive_enabled: bool = False
+    # block-pipeline span tracing (metrics/spans.py): process-global, so
+    # like log-level it only applies when set explicitly. The
+    # CORETH_TPU_SPANS env var seeds the default.
+    spans_enabled: bool = False
+    # finished-span ring capacity (debug_spanDump window)
+    span_ring_size: int = 4096
+    # per-chain flight recorder depth (debug_blockFlightRecord window)
+    flight_recorder_size: int = 64
+    # stdlib /metrics + /healthz endpoint (metrics/http.py); binds
+    # loopback unless metrics-http-host says otherwise, port 0 = ephemeral
+    metrics_http_enabled: bool = False
+    metrics_http_host: str = "127.0.0.1"
+    metrics_http_port: int = 0
 
     # --- keystore ---------------------------------------------------------
     keystore_directory: str = ""
@@ -158,6 +171,17 @@ class Config:
         if self.cpu_threads < 0:
             raise ValueError(
                 f"cpu-threads must be >= 0 (got {self.cpu_threads})")
+        if self.span_ring_size <= 0:
+            raise ValueError(
+                f"span-ring-size must be > 0 (got {self.span_ring_size})")
+        if self.flight_recorder_size <= 0:
+            raise ValueError(
+                f"flight-recorder-size must be > 0 "
+                f"(got {self.flight_recorder_size})")
+        if not (0 <= self.metrics_http_port <= 65535):
+            raise ValueError(
+                f"metrics-http-port must be in [0, 65535] "
+                f"(got {self.metrics_http_port})")
         if self.resident_account_trie is True and not self.pruning_enabled:
             raise ValueError(
                 "resident-account-trie requires pruning: interval "

@@ -153,6 +153,14 @@ class VM:
             from ..evm import interpreter as _interp
 
             _interp.FASTLOOP_DEFAULT = bool(self.full_config.evm_fastloop)
+        if "spans_enabled" in explicit:
+            from ..metrics import spans as _spans
+
+            _spans.set_enabled(self.full_config.spans_enabled)
+        if "span_ring_size" in explicit:
+            from ..metrics import spans as _spans
+
+            _spans.tracer.set_capacity(self.full_config.span_ring_size)
 
         # node keystore (node/ keystore dir role; backs avax.importKey/
         # exportKey/import/export and the eth/personal signing RPC)
@@ -200,6 +208,7 @@ class VM:
                 snapshot_limit=self.config.snapshot_limit,
                 trie_dirty_limit=full.trie_dirty_cache * 1024 * 1024,
                 accepted_cache_size=full.accepted_cache_size,
+                flight_recorder_size=full.flight_recorder_size,
             ),
             self.chain_config,
             genesis,
@@ -306,6 +315,20 @@ class VM:
                 max_files=self.full_config.continuous_profiler_max_files,
             ).start()
 
+        # stdlib /metrics + /healthz endpoint (metrics/http.py), reusing
+        # the health_check verdict the RPC health namespace serves
+        self.metrics_http = None
+        if self.full_config.metrics_http_enabled:
+            from ..metrics.http import MetricsHTTPServer
+            from .api import health_check
+
+            self.metrics_http = MetricsHTTPServer(
+                health_fn=lambda: health_check(self))
+            self.metrics_http.start(
+                host=self.full_config.metrics_http_host,
+                port=self.full_config.metrics_http_port,
+            )
+
     @staticmethod
     def _now() -> int:
         import time
@@ -400,7 +423,10 @@ class VM:
     def build_block(self) -> VMBlock:
         """buildBlock (vm.go:991-1032)."""
         try:
-            return self._build_block_inner()
+            from ..metrics.spans import span
+
+            with span("vm/buildBlock"):
+                return self._build_block_inner()
         finally:
             # the engine consumed the PendingTxs notification by calling
             # us — success or not, reopen the gate + arm the retry timer
@@ -465,6 +491,8 @@ class VM:
             self.gas_price_updater.stop()
             if self.continuous_profiler is not None:
                 self.continuous_profiler.stop()
+            if self.metrics_http is not None:
+                self.metrics_http.stop()
             self.blockchain.stop()
 
     # --- VMBlock support ---------------------------------------------------

@@ -243,7 +243,10 @@ class TestMetrics:
         assert "chain_blocks 5" in out
         assert "chain_height 42" in out
         assert "chain_txs_total 100" in out
-        assert "chain_insert_count 1" in out
+        # timers export as Prometheus summaries in seconds
+        assert "chain_insert_seconds_count 1" in out
+        assert "# TYPE chain_insert_seconds summary" in out
+        assert 'chain_insert_seconds{quantile="0.99"}' in out
 
     def test_block_path_instrumented(self):
         from coreth_tpu.metrics import default_registry

@@ -1,0 +1,514 @@
+"""Observability layer tests (ISSUE 5): Prometheus exposition validity,
+flight-recorder ring semantics, span parent/ordering invariants under
+concurrency, metric lock discipline under races, the JSON log formatter,
+the stdlib /metrics + /healthz endpoint, and the debug RPC surface."""
+
+import json
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from coreth_tpu.metrics import (Registry, Timer, sanitize_metric_name)
+from coreth_tpu.metrics import spans as spans_mod
+from coreth_tpu.metrics.__main__ import validate_exposition
+from coreth_tpu.metrics.flight import (DEFAULT_CAPACITY, FlightRecorder,
+                                       marshal_record)
+from coreth_tpu.metrics.http import (PROMETHEUS_CONTENT_TYPE,
+                                     MetricsHTTPServer)
+from coreth_tpu.metrics.spans import Tracer, _NULL_SPAN, span
+
+
+# ---------------------------------------------------------------- exposition
+
+def _populated_registry() -> Registry:
+    reg = Registry()
+    reg.counter("chain/inserts").inc(7)
+    reg.gauge("chain/head.height").update(42)
+    reg.meter("rpc/requests").mark(3)
+    h = reg.histogram("trie/keccak/batch_size")
+    for i in range(100):
+        h.update(i)
+    t = reg.timer("chain/phase/execute")
+    for i in range(50):
+        t.update(0.001 * (i + 1))
+    reg.timer("never/updated")  # zero-sample summary must still be legal
+    return reg
+
+
+class TestPrometheusExposition:
+    def test_export_is_parser_clean(self):
+        text = _populated_registry().export_prometheus()
+        assert validate_exposition(text) == []
+
+    def test_empty_registry_is_parser_clean(self):
+        assert validate_exposition(Registry().export_prometheus()) == []
+
+    def test_timer_summary_shape(self):
+        text = _populated_registry().export_prometheus()
+        fam = "chain_phase_execute_seconds"
+        assert f"# TYPE {fam} summary" in text
+        assert f"# HELP {fam} " in text
+        assert f'{fam}{{quantile="0.5"}}' in text
+        assert f'{fam}{{quantile="0.99"}}' in text
+        assert f"{fam}_count 50" in text
+
+    def test_timer_quantiles_monotone_and_sum_exact(self):
+        reg = Registry()
+        t = reg.timer("q/test")
+        for i in range(200):
+            t.update(float(i))
+        text = reg.export_prometheus()
+        qs = {}
+        total = None
+        for line in text.splitlines():
+            if line.startswith('q_test_seconds{quantile='):
+                label = line.split('"')[1]
+                qs[label] = float(line.rsplit(" ", 1)[1])
+            elif line.startswith("q_test_seconds_sum "):
+                total = float(line.rsplit(" ", 1)[1])
+        assert qs["0.5"] <= qs["0.9"] <= qs["0.99"]
+        assert total == sum(float(i) for i in range(200))
+
+    def test_hostile_names_sanitized(self):
+        assert sanitize_metric_name("chain/head.height") == "chain_head_height"
+        assert sanitize_metric_name("9starts") == "_9starts"
+        assert sanitize_metric_name("resident/fill+ratio") == \
+            "resident_fill_ratio"
+        assert sanitize_metric_name("ok:name_1") == "ok:name_1"
+
+    def test_validator_rejects_malformed(self):
+        bad = "# TYPE x counter\nx{quantile=0.5 nope\n"
+        assert validate_exposition(bad) != []
+        # sample without a preceding TYPE
+        assert validate_exposition("orphan 1\n") != []
+        # non-monotone summary quantiles
+        assert validate_exposition(
+            "# HELP s s\n# TYPE s summary\n"
+            's{quantile="0.5"} 9\ns{quantile="0.9"} 1\n'
+            "s_sum 10\ns_count 2\n") != []
+
+    def test_check_cli_passes(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "coreth_tpu.metrics", "--check"],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stdout + out.stderr
+
+
+# ---------------------------------------------------------------- metric races
+
+class TestMetricRaces:
+    def test_timer_total_exact_under_threads(self):
+        t = Timer()
+        n_threads, per = 8, 2500
+
+        def work():
+            for _ in range(per):
+                t.update(1.0)  # 1.0 is exact in binary: lost updates show
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert t.total() == float(n_threads * per)
+        assert t.count() == n_threads * per
+        assert t.hist.count() == n_threads * per
+
+    def test_gauge_update_under_threads(self):
+        reg = Registry()
+        g = reg.gauge("race/gauge")
+        vals = list(range(1, 9))
+
+        def work(v):
+            for _ in range(2000):
+                g.update(v)
+
+        threads = [threading.Thread(target=work, args=(v,)) for v in vals]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert g.value() in vals  # last-writer-wins, never torn
+
+
+# ---------------------------------------------------------------- flight ring
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        fr = FlightRecorder(capacity=4)
+        for i in range(10):
+            fr.record({"number": i, "hash": bytes([i]) * 32})
+        assert len(fr) == 4
+        assert fr.capacity() == 4
+        nums = [r["number"] for r in fr.last()]
+        assert nums == [6, 7, 8, 9]  # newest-last, oldest evicted
+
+    def test_default_capacity(self):
+        assert FlightRecorder().capacity() == DEFAULT_CAPACITY
+
+    def test_seq_monotone_and_accept_marking(self):
+        fr = FlightRecorder(capacity=8)
+        h1, h2 = b"\x01" * 32, b"\x02" * 32
+        fr.record({"number": 1, "hash": h1})
+        fr.record({"number": 2, "hash": h2})
+        seqs = [r["seq"] for r in fr.last()]
+        assert seqs == sorted(seqs) and len(set(seqs)) == 2
+        assert all(not r["accepted"] for r in fr.last())
+        fr.mark_accepted(h1)
+        accepted = fr.last(accepted_only=True)
+        assert [r["number"] for r in accepted] == [1]
+        assert fr.find(h2)["accepted"] is False
+        assert fr.find(b"\xff" * 32) is None
+
+    def test_last_n_slices_newest(self):
+        fr = FlightRecorder(capacity=8)
+        for i in range(5):
+            fr.record({"number": i, "hash": bytes([i]) * 32})
+        assert [r["number"] for r in fr.last(n=2)] == [3, 4]
+
+    def test_marshal_record_json_safe(self):
+        rec = {"number": 3, "hash": b"\xab" * 32, "txs": 5,
+               "phases": {"verify": 0.1}, "counters": {"c": 2},
+               "resident": {}, "accepted": True}
+        out = marshal_record(rec)
+        assert out["hash"] == "0x" + "ab" * 32
+        assert out["phases"] is not rec["phases"]  # copies nested dicts
+        json.dumps(out)  # round-trips
+
+    def test_concurrent_record_keeps_bounds_and_unique_seqs(self):
+        fr = FlightRecorder(capacity=32)
+
+        def work(base):
+            for i in range(200):
+                fr.record({"number": base + i, "hash": b"\x00" * 32})
+
+        threads = [threading.Thread(target=work, args=(b * 1000,))
+                   for b in range(6)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        recs = fr.last()
+        assert len(recs) == 32
+        seqs = [r["seq"] for r in recs]
+        assert seqs == sorted(seqs) and len(set(seqs)) == 32
+        assert max(seqs) == 6 * 200
+
+
+# ---------------------------------------------------------------- spans
+
+class TestSpans:
+    def test_disabled_returns_shared_null_span(self):
+        assert not spans_mod.enabled  # tests run with spans off
+        s = span("chain/insert", number=1)
+        assert s is _NULL_SPAN
+        assert span("other") is s  # no allocation per call
+        with s:
+            s.set_attr("ignored", 1)
+
+    def test_parenting_and_ordering(self):
+        tr = Tracer(capacity=16)
+        with tr.span("chain/insert") as outer:
+            with tr.span("chain/verify") as inner:
+                assert tr.current() is inner
+            assert tr.current() is outer
+        assert tr.current() is None
+        done = tr.snapshot()
+        assert [s.name for s in done] == ["chain/verify", "chain/insert"]
+        verify, insert = done
+        assert verify.parent_id == insert.span_id
+        assert insert.parent_id is None
+        assert verify.start >= insert.start
+        assert verify.end <= insert.end
+
+    def test_exception_annotates_and_unwinds(self):
+        tr = Tracer(capacity=16)
+        with pytest.raises(ValueError):
+            with tr.span("chain/insert"):
+                with tr.span("chain/verify"):
+                    raise ValueError("boom")
+        assert tr.current() is None
+        by_name = {s.name: s for s in tr.snapshot()}
+        assert by_name["chain/verify"].attrs["error"] == "ValueError"
+        assert by_name["chain/insert"].attrs["error"] == "ValueError"
+
+    def test_ring_bounded_and_resizable(self):
+        tr = Tracer(capacity=4)
+        for i in range(10):
+            with tr.span(f"s{i}"):
+                pass
+        assert len(tr.snapshot()) == 4
+        tr.set_capacity(2)
+        assert tr.capacity() == 2
+        assert len(tr.snapshot()) == 2
+
+    def test_thread_stacks_do_not_cross(self):
+        tr = Tracer(capacity=256)
+        barrier = threading.Barrier(4)
+
+        def work(i):
+            with tr.span(f"root/{i}"):
+                barrier.wait(timeout=10)  # all roots open simultaneously
+                with tr.span(f"child/{i}"):
+                    pass
+
+        threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        spans = tr.snapshot()
+        assert len(spans) == 8
+        roots = {s.name.split("/")[1]: s for s in spans
+                 if s.name.startswith("root/")}
+        for s in spans:
+            if s.name.startswith("child/"):
+                i = s.name.split("/")[1]
+                # parented under the SAME thread's root, despite all four
+                # roots being open concurrently
+                assert s.parent_id == roots[i].span_id
+                assert s.tid == roots[i].tid
+
+    def test_chrome_trace_shape(self):
+        tr = Tracer(capacity=16)
+        with tr.span("chain/insert", number=7):
+            pass
+        trace = tr.chrome_trace()
+        (ev,) = trace["traceEvents"]
+        assert ev["ph"] == "X"
+        assert ev["cat"] == "chain"
+        assert ev["args"]["number"] == 7
+        assert ev["dur"] >= 0 and ev["ts"] >= 0
+        json.dumps(trace)
+        # clear=True drains the ring
+        tr.chrome_trace(clear=True)
+        assert tr.snapshot() == []
+
+    def test_set_enabled_toggles_module_gate(self):
+        assert not spans_mod.enabled
+        spans_mod.set_enabled(True)
+        try:
+            s = span("toggle/test")
+            assert s is not _NULL_SPAN
+            with s:
+                pass
+        finally:
+            spans_mod.set_enabled(False)
+        assert span("toggle/test") is _NULL_SPAN
+
+
+# ---------------------------------------------------------------- logging
+
+class TestLogFormatter:
+    def _format(self, **kwargs):
+        import logging
+
+        from coreth_tpu.log import _JSONFormatter
+
+        rec = logging.LogRecord("coreth_tpu.t", logging.ERROR, "f.py", 1,
+                                kwargs.pop("msg", "it broke"), (), None)
+        rec.__dict__.update(kwargs)
+        return json.loads(_JSONFormatter().format(rec))
+
+    def test_exc_field_on_exc_info(self):
+        try:
+            raise RuntimeError("kapow")
+        except RuntimeError:
+            out = self._format(exc_info=sys.exc_info())
+        assert "RuntimeError: kapow" in out["exc"]
+        assert "Traceback" in out["exc"]
+
+    def test_no_exc_field_without_exc_info(self):
+        assert "exc" not in self._format()
+
+    def test_ctx_kwargs_merge(self):
+        out = self._format(ctx={"block": 9, "hash": "0xab"})
+        assert out["block"] == 9 and out["hash"] == "0xab"
+
+    def test_leveled_ctx_helpers(self):
+        import io
+        import logging
+
+        from coreth_tpu import log as clog
+
+        stream = io.StringIO()
+        clog.init(level="debug", json_format=True, stream=stream)
+        try:
+            lg = clog.get_logger("obs_test")
+            clog.debug(lg, "d", a=1)
+            clog.info(lg, "i", b=2)
+            clog.warn(lg, "w", c=3)
+            try:
+                raise ValueError("inner")
+            except ValueError:
+                clog.error(lg, "e", exc_info=sys.exc_info(), d=4)
+            lines = [json.loads(l) for l in
+                     stream.getvalue().strip().splitlines()]
+        finally:
+            clog.init(level="info", json_format=False)
+        assert [l["lvl"] for l in lines] == ["debug", "info", "warning",
+                                             "error"]
+        assert lines[0]["a"] == 1 and lines[2]["c"] == 3
+        assert "ValueError: inner" in lines[3]["exc"]
+
+
+# ---------------------------------------------------------------- HTTP endpoint
+
+@pytest.fixture
+def http_server():
+    reg = Registry()
+    reg.counter("http/test/hits").inc(3)
+    reg.timer("http/test/lat").update(0.5)
+    health = {"healthy": True}
+    srv = MetricsHTTPServer(registry=reg, health_fn=lambda: dict(health))
+    port = srv.start(host="127.0.0.1", port=0)
+    yield srv, port, health
+    srv.stop()
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+            return resp.status, resp.headers, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers, e.read()
+
+
+class TestMetricsHTTP:
+    def test_metrics_endpoint_parser_clean(self, http_server):
+        _, port, _ = http_server
+        status, headers, body = _get(port, "/metrics")
+        assert status == 200
+        assert headers["Content-Type"] == PROMETHEUS_CONTENT_TYPE
+        assert int(headers["Content-Length"]) == len(body)
+        text = body.decode()
+        assert validate_exposition(text) == []
+        assert "http_test_hits 3" in text
+        assert "# TYPE http_test_lat_seconds summary" in text
+
+    def test_healthz_flips_with_verdict(self, http_server):
+        _, port, health = http_server
+        status, _, body = _get(port, "/healthz")
+        assert status == 200 and json.loads(body)["healthy"] is True
+        health["healthy"] = False
+        status, _, body = _get(port, "/healthz")
+        assert status == 503 and json.loads(body)["healthy"] is False
+
+    def test_unknown_path_404(self, http_server):
+        _, port, _ = http_server
+        assert _get(port, "/nope")[0] == 404
+        assert _get(port, "/metrics/extra")[0] == 404
+
+    def test_post_405(self, http_server):
+        _, port, _ = http_server
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/metrics", data=b"x", method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 405
+
+    def test_health_fn_crash_is_500_not_traceback(self):
+        srv = MetricsHTTPServer(registry=Registry(),
+                                health_fn=lambda: 1 // 0)
+        port = srv.start(host="127.0.0.1", port=0)
+        try:
+            status, _, body = _get(port, "/healthz")
+            assert status == 500
+            assert b"Traceback" not in body
+        finally:
+            srv.stop()
+
+    def test_stop_releases_port(self):
+        srv = MetricsHTTPServer(registry=Registry())
+        srv.start(host="127.0.0.1", port=0)
+        srv.stop()
+        assert srv.port is None
+
+
+# ---------------------------------------------------------------- debug RPC
+
+class _StubChain:
+    def __init__(self):
+        self.flight_recorder = FlightRecorder(capacity=8)
+
+
+class _StubVM:
+    def __init__(self):
+        self.blockchain = _StubChain()
+
+
+@pytest.fixture
+def debug_server():
+    from coreth_tpu.rpc.server import RPCServer
+    from coreth_tpu.vm.api import DebugMetricsAPI
+
+    vm = _StubVM()
+    server = RPCServer()
+    server.register_api("debug", DebugMetricsAPI(vm))
+    yield vm, server
+    server.stop()
+
+
+def _rpc(server, method, *params):
+    resp = json.loads(server.handle_raw(json.dumps(
+        {"jsonrpc": "2.0", "id": 1, "method": method,
+         "params": list(params)}).encode()))
+    if "error" in resp:
+        raise RuntimeError(resp["error"])
+    return resp["result"]
+
+
+class TestDebugRPC:
+    def test_debug_metrics(self, debug_server):
+        from coreth_tpu.metrics import default_registry
+
+        default_registry.counter("rpc_obs/test").inc(2)
+        _, server = debug_server
+        out = _rpc(server, "debug_metrics")
+        assert out["rpc_obs/test"] == {"type": "counter", "count": 2}
+
+    def test_debug_block_flight_record(self, debug_server):
+        vm, server = debug_server
+        fr = vm.blockchain.flight_recorder
+        fr.record({"number": 1, "hash": b"\x01" * 32, "txs": 2,
+                   "phases": {"verify": 0.01}})
+        fr.record({"number": 2, "hash": b"\x02" * 32, "txs": 3,
+                   "phases": {"verify": 0.02}})
+        fr.mark_accepted(b"\x02" * 32)
+        accepted = _rpc(server, "debug_blockFlightRecord")
+        assert [r["number"] for r in accepted] == [2]
+        assert accepted[0]["hash"] == "0x" + "02" * 32
+        everything = _rpc(server, "debug_blockFlightRecord", None, False)
+        assert [r["number"] for r in everything] == [1, 2]
+
+    def test_debug_span_dump_and_toggle(self, debug_server):
+        _, server = debug_server
+        assert _rpc(server, "debug_setSpans", True) is True
+        try:
+            with span("rpc_obs/traced"):
+                pass
+            trace = _rpc(server, "debug_spanDump")
+            assert any(ev["name"] == "rpc_obs/traced"
+                       for ev in trace["traceEvents"])
+        finally:
+            assert _rpc(server, "debug_setSpans", False) is False
+
+    def test_debug_set_expensive_metrics(self, debug_server):
+        from coreth_tpu import metrics as m
+
+        _, server = debug_server
+        before = m.enabled_expensive
+        try:
+            assert _rpc(server, "debug_setExpensiveMetrics", True) is True
+            assert m.enabled_expensive is True
+            assert _rpc(server, "debug_setExpensiveMetrics", False) is False
+        finally:
+            m.enabled_expensive = before

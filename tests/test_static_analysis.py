@@ -163,6 +163,47 @@ def test_sa003_fires_on_random_and_ctypes_alloc():
     assert len(out) == 2
 
 
+@pytest.mark.parametrize("call", [
+    "registry.timer('fixture/step')",
+    "metrics.get_or_register_timer('fixture/step')",
+    "registry.histogram('fixture/sizes')",
+    "tracer.start_span('fixture/step')",
+    "Span(tracer, 'fixture/step', {})",
+])
+def test_sa003_fires_on_metric_construction_in_hot_path(call):
+    src = f"""
+    def step(vm, registry, metrics, tracer, Span):  # hot-path
+        m = {call}
+        return m
+    """
+    out = [f for f in findings(src) if f.rule == "SA003"]
+    assert len(out) == 1
+    assert "hoist" in out[0].message
+
+
+@pytest.mark.parametrize("call", [
+    "phase_timer('fixture/phase')",
+    "expensive_timer('fixture/phase')",
+    "span('fixture/step', n=1)",
+    "spans.span('fixture/step')",
+])
+def test_sa003_quiet_on_gated_observability_helpers(call):
+    src = f"""
+    def step(vm, phase_timer, expensive_timer, span, spans):  # hot-path
+        with {call}:
+            return vm.pc + 1
+    """
+    assert [f for f in findings(src) if f.rule == "SA003"] == []
+
+
+def test_sa003_quiet_on_metric_construction_off_hot_path():
+    src = """
+    def setup(registry):
+        return registry.timer('fixture/step')
+    """
+    assert [f for f in findings(src) if f.rule == "SA003"] == []
+
+
 def test_sa003_quiet_without_marker_and_on_clean_hot_fn():
     cold = """
     import time

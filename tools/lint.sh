@@ -11,6 +11,10 @@ echo "== coreth_tpu.analysis (AST lint: SA001-SA005, baseline-gated) =="
 python -m coreth_tpu.analysis || rc=1
 
 echo
+echo "== coreth_tpu.metrics --check (Prometheus exposition self-test) =="
+python -m coreth_tpu.metrics --check || rc=1
+
+echo
 if python -c "import mypy" >/dev/null 2>&1; then
     echo "== mypy (strict core subset, mypy.ini) =="
     python -m mypy --config-file mypy.ini || rc=1
