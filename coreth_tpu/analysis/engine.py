@@ -116,6 +116,12 @@ class Rule:
     def check(self, src: SourceFile) -> Iterator[Finding]:  # pragma: no cover
         raise NotImplementedError
 
+    def finalize(self) -> Iterator[Finding]:
+        """Cross-file pass, called once after every file has been
+        check()ed.  Stateful rules (SA006 failpoint registry) report
+        whole-package invariants here; the default has none."""
+        return iter(())
+
     def finding(self, src: SourceFile, node: ast.AST, qualname: str,
                 message: str) -> Finding:
         return Finding(self.id, src.relpath, getattr(node, "lineno", 0),
@@ -216,5 +222,7 @@ class Engine:
         out: List[Finding] = []
         for path in sorted(package_root.rglob("*.py")):
             out.extend(self.check_file(path, package_root))
+        for rule in self.rules:
+            out.extend(rule.finalize())
         out.sort(key=lambda f: (f.path, f.line, f.rule))
         return out

@@ -14,6 +14,10 @@ SA004 consensus-float  no float arithmetic where bit-exactness is the
 SA005 unordered-iter   no set-order-dependent iteration feeding RLP or
                        hashing (bytes/str hashes are salted per process:
                        set order is not reproducible across nodes)
+SA006 failpoint-hygiene  failpoint names are unique string literals
+                       registered at module import; `failpoint()` only
+                       fires registered names; no naked `time.sleep`
+                       outside coreth_tpu/fault/ (use fault.Backoff)
 """
 
 from __future__ import annotations
@@ -575,9 +579,141 @@ class UnorderedIterationRule(Rule):
                 and node.func.attr in ("keys", "items"))
 
 
+# ------------------------------------------------------------------ SA006
+
+# The one sanctioned home for a raw sleep: fault.Backoff centralizes
+# retry pacing (capped exponential + jitter) so chaos tests can reason
+# about every wait in the system.
+SLEEP_EXEMPT_PATHS = ("coreth_tpu/fault/",)
+FAILPOINT_FUNCS = {"register", "failpoint"}
+
+
+class FailpointHygieneRule(Rule):
+    """Failpoint names are part of the debug/chaos API surface: they must
+    be unique string literals registered at import time so
+    `debug_listFailpoints` is the complete, greppable catalogue and an
+    env spec can never silently name a point that does not exist.  The
+    companion check bans naked `time.sleep` outside the fault package —
+    ad-hoc sleeps are unbounded, unjittered, and invisible to the
+    degradation ladder (use `fault.Backoff`)."""
+
+    id = "SA006"
+    title = "failpoint hygiene / naked time.sleep"
+
+    def __init__(self):
+        # cross-file state, reported in finalize()
+        self._registered: Dict[str, Tuple[str, str]] = {}  # name -> site
+        self._fired: List[Tuple[str, str, int, str]] = []  # name, path, line, qn
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        rule = self
+        findings: List[Finding] = []
+        # alias maps for this file: local-name -> canonical function
+        fp_aliases: Dict[str, str] = {}   # e.g. {"register": "register"}
+        mod_aliases: Set[str] = set()     # modules exposing .register/.failpoint
+        sleep_names: Set[str] = set()     # `from time import sleep [as x]`
+        sleep_ok = any(src.relpath == p or src.relpath.startswith(p)
+                       for p in SLEEP_EXEMPT_PATHS)
+
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod == "fault" or mod.endswith(".fault"):
+                    for a in node.names:
+                        if a.name in FAILPOINT_FUNCS:
+                            fp_aliases[a.asname or a.name] = a.name
+                if mod == "time":
+                    for a in node.names:
+                        if a.name == "sleep":
+                            sleep_names.add(a.asname or a.name)
+                for a in node.names:  # `from .. import fault [as f]`
+                    if a.name == "fault":
+                        mod_aliases.add(a.asname or a.name)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "fault" or a.name.endswith(".fault"):
+                        mod_aliases.add(a.asname or a.name.split(".")[0])
+
+        def resolve(call: ast.Call) -> Optional[str]:
+            """Canonical 'register'/'failpoint' if this call targets the
+            fault package through any import shape, else None."""
+            fn = call.func
+            if isinstance(fn, ast.Name):
+                return fp_aliases.get(fn.id)
+            if isinstance(fn, ast.Attribute) and fn.attr in FAILPOINT_FUNCS:
+                recv = dotted(fn.value)
+                if recv is not None and (recv in mod_aliases
+                                         or recv.split(".")[-1] == "fault"):
+                    return fn.attr
+            return None
+
+        class V(QualnameVisitor):
+            def visit_Call(self, node: ast.Call) -> None:
+                name = dotted(node.func)
+                if not sleep_ok and (
+                        name == "time.sleep"
+                        or (isinstance(node.func, ast.Name)
+                            and node.func.id in sleep_names)):
+                    findings.append(rule.finding(
+                        src, node, self.qualname,
+                        "naked time.sleep — retry pacing goes through "
+                        "fault.Backoff (capped exponential + jitter), "
+                        "visible to chaos tooling"))
+                which = resolve(node)
+                if which is not None:
+                    findings.extend(
+                        rule._check_failpoint_call(src, node, self.qualname,
+                                                   which))
+                self.generic_visit(node)
+
+        V().visit(src.tree)
+        return iter(findings)
+
+    def _check_failpoint_call(self, src: SourceFile, node: ast.Call,
+                              qualname: str, which: str) -> List[Finding]:
+        out: List[Finding] = []
+        arg = node.args[0] if node.args else None
+        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+            out.append(self.finding(
+                src, node, qualname,
+                f"`{which}(...)` needs a literal string name — computed "
+                f"names defeat the greppable failpoint catalogue"))
+            return out
+        name = arg.value
+        if which == "register":
+            if qualname != "<module>":
+                out.append(self.finding(
+                    src, node, qualname,
+                    f"failpoint {name!r} registered inside {qualname} — "
+                    f"registration must run at import (module scope) so "
+                    f"debug_listFailpoints is complete at boot"))
+            prior = self._registered.get(name)
+            if prior is not None and prior != (src.relpath, qualname):
+                out.append(self.finding(
+                    src, node, qualname,
+                    f"failpoint {name!r} already registered at "
+                    f"{prior[0]} [{prior[1]}] — names are global and must "
+                    f"be unique"))
+            else:
+                self._registered[name] = (src.relpath, qualname)
+        else:
+            self._fired.append((name, src.relpath,
+                                getattr(node, "lineno", 0), qualname))
+        return out
+
+    def finalize(self) -> Iterator[Finding]:
+        for name, path, line, qualname in self._fired:
+            if name not in self._registered:
+                yield Finding(
+                    self.id, path, line, qualname,
+                    f"failpoint({name!r}) fires a name no module "
+                    f"registers — arm via debug_setFailpoint would "
+                    f"KeyError; add a module-scope register()")
+
+
 ALL_RULES: Tuple[type, ...] = (
     SilentExceptRule, LockDisciplineRule, HotPathPurityRule,
-    ConsensusFloatRule, UnorderedIterationRule,
+    ConsensusFloatRule, UnorderedIterationRule, FailpointHygieneRule,
 )
 
 

@@ -17,6 +17,8 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from .. import rlp
+from ..fault import failpoint
+from ..fault import register as _register_failpoint
 from ..metrics.flight import FlightRecorder
 from ..metrics.spans import span as _span
 from ..state.database import Database
@@ -33,6 +35,44 @@ from .types import Block, Body, Header, Receipt, create_bloom, derive_sha
 
 class ChainError(Exception):
     pass
+
+
+class TailStalled(ChainError):
+    """A bounded join on the insert tail / acceptor queue expired: the
+    async worker is wedged (or its current item is), and the caller
+    refuses to block forever. Carries enough context to diagnose WHERE
+    the pipeline stopped without attaching a debugger."""
+
+    def __init__(self, what: str, timeout: float, depth: int,
+                 last_record: Optional[dict] = None,
+                 worker_error: Optional[str] = None):
+        self.what = what
+        self.timeout = timeout
+        self.depth = depth
+        self.last_record = last_record
+        self.worker_error = worker_error
+        at = ""
+        if last_record:
+            at = (f"; last flight record: block {last_record.get('number')}"
+                  f" phases={sorted(last_record.get('phases', {}))}")
+        err = f"; worker error:\n{worker_error}" if worker_error else ""
+        super().__init__(
+            f"{what} still has {depth} unfinished item(s) after "
+            f"{timeout:.1f}s{at}{err}")
+
+
+# insert-tail failpoint sites (coreth_tpu/fault): `raise`/`hang` here
+# simulate a crash between the tail's ordered disk writes — the torn
+# states the boot-time repair scan must handle.
+FP_TAIL_BEFORE_BODY = _register_failpoint(
+    "chain/tail/before_body", "before any rawdb write for a block")
+FP_TAIL_PARTIAL_BODY = _register_failpoint(
+    "chain/tail/partial_body",
+    "after the header writes, before body/receipts — a torn body")
+FP_TAIL_BEFORE_HEAD = _register_failpoint(
+    "chain/tail/before_head",
+    "after a block's body is durable, before the canonical-hash/"
+    "head-pointer writes")
 
 
 @dataclass
@@ -80,6 +120,25 @@ class CacheConfig:
     # per-chain flight recorder: ring size of retained per-block phase
     # records (metrics/flight.py; served by debug_blockFlightRecord)
     flight_recorder_size: int = 64
+    # --- robustness knobs (ROBUSTNESS.md) ---
+    # per-call watchdog deadline (seconds) for laddered device dispatches
+    # (ops/device.DeviceLadder); 0 disables the watchdog — dispatches run
+    # inline with no extra thread, the pre-ladder behavior
+    device_call_timeout: float = 0.0
+    # transient-error retries (with capped backoff) before a dispatch
+    # demotes the device to host
+    device_max_retries: int = 1
+    # seconds between background health probes while demoted; <= 0 means
+    # a demoted device is never re-promoted
+    device_probe_interval: float = 5.0
+    # consecutive healthy probes required for re-promotion
+    device_promote_after: int = 3
+    # resident-mirror spot check (device root vs host keccak oracle)
+    # every K committed inserts; 0 disables
+    resident_spot_check_interval: int = 0
+    # deadline (seconds) for join_tail / acceptor-queue joins; on expiry
+    # they raise TailStalled instead of blocking forever. 0 = unbounded
+    tail_join_timeout: float = 0.0
 
 
 # counter/timer families snapshotted around each insert so the flight
@@ -239,6 +298,46 @@ class BlockChain:
         self.current_block: Block = self.genesis_block
         self.last_accepted: Block = self.genesis_block
 
+        # recent insertion failures for debug_getBadBlocks (core
+        # reportBlock keeps a similar bounded set)
+        from collections import deque
+
+        # bad_blocks holds (block, reason, flight_record) — the record is
+        # the in-flight phase breakdown captured up to the failure point
+        # (None when the failure precedes any instrumented phase)
+        self.bad_blocks = deque(maxlen=10)
+        # per-chain flight recorder (metrics/flight.py): last-N per-block
+        # phase/counter records, served by debug_blockFlightRecord
+        self.flight_recorder = FlightRecorder(cache_config.flight_recorder_size)
+        # record of the insert currently running under chainmu; read by
+        # _insert_checked to attach phase context to bad-block entries
+        self._insert_rec: Optional[dict] = None
+
+        # device degradation ladder (ops/device.py): configure the
+        # process-wide ladder from this chain's knobs and pipe its
+        # demote/probation/promote events into the flight recorder
+        from ..ops.device import default_ladder
+
+        self._ladder = default_ladder()
+        self._ladder.configure(
+            call_timeout=cache_config.device_call_timeout,
+            max_retries=cache_config.device_max_retries,
+            probe_interval=cache_config.device_probe_interval,
+            promote_after=cache_config.device_promote_after,
+        )
+        self._ladder.add_listener(self._on_device_event)
+        # set by a mirror takeover; a later ladder re-promotion reboots
+        # the (now host-mode) mirror back onto the device
+        self._mirror_degraded = False
+        self._spot_check_countdown = cache_config.resident_spot_check_interval
+
+        # crash consistency: the insert tail orders body-before-head, so
+        # a kill can only lose whole tails — but a database written by a
+        # pre-ordering version (or torn some other way) can have its head
+        # pointer ahead of fully-persisted block data. Repair BEFORE the
+        # head restore below trusts the pointer.
+        self._repair_torn_tail()
+
         # restore pointers if the db has a head
         head = rawdb.read_head_block_hash(diskdb)
         if head is not None and head != self.genesis_block.hash():
@@ -266,20 +365,6 @@ class BlockChain:
         # guarantees it exists), then route account-trie lifecycle through
         # it. Genesis/recovery writes above intentionally used the default
         # writer; history before this point lives on disk.
-        # recent insertion failures for debug_getBadBlocks (core
-        # reportBlock keeps a similar bounded set)
-        from collections import deque
-
-        # bad_blocks holds (block, reason, flight_record) — the record is
-        # the in-flight phase breakdown captured up to the failure point
-        # (None when the failure precedes any instrumented phase)
-        self.bad_blocks = deque(maxlen=10)
-        # per-chain flight recorder (metrics/flight.py): last-N per-block
-        # phase/counter records, served by debug_blockFlightRecord
-        self.flight_recorder = FlightRecorder(cache_config.flight_recorder_size)
-        # record of the insert currently running under chainmu; read by
-        # _insert_checked to attach phase context to bad-block entries
-        self._insert_rec: Optional[dict] = None
         self.mirror = None
         # resident mode is a PRUNING policy (interval persistence): under
         # pruning=False the archive guarantee — every block's state on
@@ -496,6 +581,7 @@ class BlockChain:
             cpu_threads=self.cache_config.cpu_threads,
             prefer_host=None if prefer == "auto" else bool(prefer),
         )
+        self.mirror.on_takeover = self._on_mirror_takeover
         self.state_database.mirror = self.mirror
         self.trie_writer = ResidentTrieWriter(
             self.state_database.triedb,
@@ -513,6 +599,126 @@ class BlockChain:
         if self.mirror is None:
             return
         self._boot_mirror()
+
+    # ------------------------------------------- device degradation ladder
+
+    def _on_device_event(self, kind: str, fields: dict) -> None:
+        """DeviceLadder listener: every ladder transition lands in the
+        flight recorder's event ring (debug_flightEvents), and a
+        re-promotion after a mirror takeover reboots the mirror back
+        onto the device. Runs on whichever thread tripped the ladder —
+        never under the ladder's own lock (ops/device._notify) — so
+        taking chainmu here cannot invert against a dispatch under it."""
+        self.flight_recorder.note_event("device/" + kind, **fields)
+        if kind == "promote" and self._mirror_degraded:
+            self._mirror_degraded = False
+            # the takeover pinned the mirror's trie to host mode
+            # one-way; residency only returns via a rebuild
+            with self.chainmu:
+                try:
+                    self.reboot_mirror()
+                    self.flight_recorder.note_event("mirror/reboot")
+                except Exception:
+                    from ..metrics import count_drop
+
+                    count_drop("chain/mirror/reboot_error")
+
+    def _on_mirror_takeover(self, why: str) -> None:
+        """ResidentAccountMirror.on_takeover hook (fires under the mirror
+        lock): a wedged resident commit is the same sick device the
+        ladder tracks — demote everything and let its probes decide when
+        the hardware earned its way back. Must not take chainmu (lock
+        order is chainmu -> mirror lock)."""
+        self._mirror_degraded = True
+        self.flight_recorder.note_event("mirror/takeover", why=why)
+        self._ladder.demote(f"resident mirror takeover: {why}")
+
+    def _spot_check_mirror(self) -> None:
+        """Periodic device-vs-host cross-check of the resident mirror
+        (every resident_spot_check_interval committed inserts): a
+        diverged mirror is QUARANTINED — rebuilt from the last-accepted
+        disk state — instead of feeding consensus wrong roots. Caller
+        holds chainmu."""
+        from ..log import error, get_logger
+        from ..metrics import default_registry as _metrics
+
+        mirror = self.mirror
+        if mirror is None:
+            return
+        if mirror.spot_check():
+            return
+        _metrics.counter("chain/mirror/quarantines").inc()
+        self.flight_recorder.note_event(
+            "mirror/quarantine", at=self.last_accepted.number)
+        error(get_logger("chain"),
+              "resident mirror diverged from the host keccak oracle — "
+              "quarantining: mirror rebuilt from last-accepted state",
+              last_accepted=self.last_accepted.number)
+        # the accepted disk image is the trust anchor; anything the
+        # diverged mirror held above it is re-verified on insert
+        self.join_tail()
+        self.reboot_mirror()
+
+    # ---------------------------------------------- crash-consistent tail
+
+    def _block_data_complete(self, number: int, block_hash: bytes) -> bool:
+        """True iff every row the insert tail writes for a block is
+        present (header number mapping, header, body, receipts)."""
+        return (
+            rawdb.read_header_number(self.diskdb, block_hash) is not None
+            and rawdb.read_header_rlp(
+                self.diskdb, number, block_hash) is not None
+            and rawdb.read_body_rlp(
+                self.diskdb, number, block_hash) is not None
+            and rawdb.read_receipts_rlp(
+                self.diskdb, number, block_hash) is not None
+        )
+
+    def _repair_torn_tail(self) -> None:
+        """Boot-time torn-tail scan: if the head pointer references a
+        block whose data never fully persisted (a crash between the
+        tail's writes, or a database from before the body-before-head
+        ordering), rewind the head to the last canonical block whose
+        data is complete and drop the canonical rows above it. The
+        blocks lost were never fully durable; consensus re-delivers
+        them."""
+        from ..log import get_logger, warn
+        from ..metrics import default_registry as _metrics
+
+        gen_h = self.genesis_block.hash()
+        head = rawdb.read_head_block_hash(self.diskdb)
+        if head is None or head == gen_h:
+            return
+        head_n = rawdb.read_header_number(self.diskdb, head)
+        if head_n is not None and self._block_data_complete(head_n, head):
+            return
+        # torn: find the canonical tip number (the header-number row for
+        # the head hash may itself be missing), then walk down to the
+        # last complete block
+        if head_n is None:
+            head_n = 0
+            while rawdb.read_canonical_hash(
+                    self.diskdb, head_n + 1) is not None:
+                head_n += 1
+        new_n, new_h = 0, gen_h
+        k = head_n
+        while k > 0:
+            h = rawdb.read_canonical_hash(self.diskdb, k)
+            if h is not None and self._block_data_complete(k, h):
+                new_n, new_h = k, h
+                break
+            k -= 1
+        for num in range(new_n + 1, head_n + 1):
+            rawdb.delete_canonical_hash(self.diskdb, num)
+        rawdb.write_head_block_hash(self.diskdb, new_h)
+        _metrics.counter("chain/tail/torn_repairs").inc()
+        self.flight_recorder.note_event(
+            "tail/torn_repair", torn_head=head.hex(), torn_number=head_n,
+            repaired_number=new_n)
+        warn(get_logger("chain"),
+             "torn insert tail repaired at boot: head pointer was ahead "
+             "of persisted block data; rewound to last consistent block",
+             torn_head=head.hex(), torn_number=head_n, repaired_number=new_n)
 
     def has_state(self, root: bytes) -> bool:
         from ..trie.node import EMPTY_ROOT
@@ -696,6 +902,18 @@ class BlockChain:
                 raise ChainError("commit root mismatch")
             self.trie_writer.insert_trie(block)
 
+        # periodic resident-mirror spot check (device root vs host
+        # keccak oracle, ROBUSTNESS.md): a diverged mirror quarantines —
+        # rebuilt from last-accepted state; the unaccepted suffix gets
+        # re-verified by consensus re-inserts
+        if (self.mirror is not None
+                and self.cache_config.resident_spot_check_interval > 0):
+            self._spot_check_countdown -= 1
+            if self._spot_check_countdown <= 0:
+                self._spot_check_countdown = (
+                    self.cache_config.resident_spot_check_interval)
+                self._spot_check_mirror()
+
         # committed inserts enter the ring; the async tail stamps `write`
         self.flight_recorder.record(rec)
         self._write_block(block, receipts, statedb._deferred_snap_update,
@@ -724,14 +942,16 @@ class BlockChain:
         # the trie fallback for one read
         ev = threading.Event()
         self._tail_snap_applied = ev
-        self._tail_queue.put((block, receipts, snap_update, ev, rec))
+        self._tail_queue.put(("block", block, receipts, snap_update, ev, rec))
 
     def _write_block_data(self, block: Block, receipts: List[Receipt]) -> None:
         """rawdb persistence for one inserted block (tail-worker body)."""
         h = block.hash()
         n = block.number
+        failpoint("chain/tail/before_body")
         rawdb.write_header_number(self.diskdb, h, n)
         rawdb.write_header_rlp(self.diskdb, n, h, block.header.encode())
+        failpoint("chain/tail/partial_body")
         body_items = [
             [rlp.decode(t.encode()) if t.type == 0 else t.encode() for t in block.transactions],
             [u.rlp_items() for u in block.uncles],
@@ -752,7 +972,26 @@ class BlockChain:
             if item is None:
                 self._tail_queue.task_done()
                 return
-            block, receipts, snap_update, snap_applied, rec = item
+            if item[0] == "head":
+                # canonical-hash + head-pointer writes ride the same FIFO
+                # BEHIND the block's body item (_write_canonical enqueues
+                # after _write_block), so the pointer can never reach disk
+                # before the data it points at — crash consistency by
+                # ordering, not fsync
+                _, block = item
+                try:
+                    failpoint("chain/tail/before_head")
+                    rawdb.write_canonical_hash(
+                        self.diskdb, block.hash(), block.number)
+                    rawdb.write_head_block_hash(self.diskdb, block.hash())
+                except Exception:
+                    import traceback
+
+                    self.tail_error = traceback.format_exc()
+                finally:
+                    self._tail_queue.task_done()
+                continue
+            _, block, receipts, snap_update, snap_applied, rec = item
             try:
                 t0 = time.monotonic()
                 with _span("chain/write", number=block.number):
@@ -775,10 +1014,35 @@ class BlockChain:
                 snap_applied.set()  # never leave a joiner hanging
                 self._tail_queue.task_done()
 
-    def join_tail(self) -> None:
+    def _join_queue(self, q: "queue.Queue", what: str,
+                    timeout: Optional[float]) -> None:
+        """Queue.join with a deadline: raises TailStalled (with queue
+        depth + last flight record + any worker error) instead of
+        blocking forever on a wedged worker. timeout None/<=0 keeps the
+        unbounded join."""
+        if not timeout or timeout <= 0:
+            q.join()
+            return
+        deadline = time.monotonic() + timeout
+        with q.all_tasks_done:
+            while q.unfinished_tasks:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    last = self.flight_recorder.last(1)
+                    raise TailStalled(
+                        what, timeout, q.unfinished_tasks,
+                        last_record=last[-1] if last else None,
+                        worker_error=self.tail_error or self.acceptor_error)
+                q.all_tasks_done.wait(remaining)
+
+    def join_tail(self, timeout: Optional[float] = None) -> None:
         """Wait until every queued insert tail has reached disk; raises
-        (once) if the tail worker failed."""
-        self._tail_queue.join()
+        (once) if the tail worker failed. [timeout] (default: the
+        tail_join_timeout knob; 0 = unbounded) bounds the wait — on
+        expiry TailStalled carries the diagnosis instead of a hang."""
+        if timeout is None:
+            timeout = self.cache_config.tail_join_timeout
+        self._join_queue(self._tail_queue, "insert tail", timeout)
         if self.tail_error is not None:
             err, self.tail_error = self.tail_error, None
             raise ChainError(f"insert tail failed:\n{err}")
@@ -786,16 +1050,25 @@ class BlockChain:
     def _wait_tail_snap(self) -> None:
         """Wait only for pending snapshot diff-layer attaches (the cheap
         head of the tail) — what state reads need for layer lookup."""
-        self._tail_snap_applied.wait()
+        timeout = self.cache_config.tail_join_timeout
+        if not self._tail_snap_applied.wait(timeout if timeout > 0 else None):
+            raise TailStalled(
+                "insert-tail snapshot attach", timeout,
+                self._tail_queue.unfinished_tasks,
+                worker_error=self.tail_error)
         if self.tail_error is not None:
             err, self.tail_error = self.tail_error, None
             raise ChainError(f"insert tail failed:\n{err}")
 
-    def _write_canonical(self, block: Block) -> None:
+    def _write_canonical(self, block: Block) -> None:  # guarded-by: chainmu
+        """Extend the canonical chain: in-memory mappings flip
+        synchronously (readers under chainmu see the new head at once),
+        but the DISK canonical-hash/head-pointer writes are enqueued
+        behind the block's body on the insert tail, enforcing
+        body-before-head durability ordering."""
         self._canonical[block.number] = block.hash()
-        rawdb.write_canonical_hash(self.diskdb, block.hash(), block.number)
-        rawdb.write_head_block_hash(self.diskdb, block.hash())
         self.current_block = block
+        self._tail_queue.put(("head", block))
 
     def reprocess_state(self, target: Block, reexec_limit: int) -> None:
         """reprocessState (blockchain.go:1745): walk back to the nearest
@@ -969,9 +1242,14 @@ class BlockChain:
             if self._acceptor_tip is block:
                 self._acceptor_tip = None
 
-    def drain_acceptor_queue(self) -> None:
-        """Block until all queued Accepts have been post-processed."""
-        self._acceptor_queue.join()
+    def drain_acceptor_queue(self, timeout: Optional[float] = None) -> None:
+        """Block until all queued Accepts have been post-processed.
+        [timeout] (default: the tail_join_timeout knob; 0 = unbounded)
+        bounds the wait with a TailStalled instead of an indefinite
+        hang on a wedged acceptor."""
+        if timeout is None:
+            timeout = self.cache_config.tail_join_timeout
+        self._join_queue(self._acceptor_queue, "acceptor queue", timeout)
         self._acceptor_wg.set()
 
     # ----------------------------------------------------- preference/reorg
@@ -986,9 +1264,13 @@ class BlockChain:
             return
         self._reorg(self.current_block, block)
 
-    def _reorg(self, old_head: Block, new_head: Block) -> None:
+    def _reorg(self, old_head: Block, new_head: Block) -> None:  # guarded-by: chainmu
         """reorg (blockchain.go:1424+): rewind canonical mappings to the
         common ancestor, then write the new chain's canonical pointers."""
+        # land queued tails first: the direct canonical/head writes below
+        # must not overtake body (or head) items still in the tail queue,
+        # or the body-before-head ordering breaks exactly when it matters
+        self.join_tail()
         new_chain = []
         old, new = old_head, new_head
         while new.number > old.number:
@@ -1047,6 +1329,7 @@ class BlockChain:
             finally:
                 self._tail_queue.put(None)
                 self._tail_thread.join(timeout=5)
+        self._ladder.remove_listener(self._on_device_event)
         self.trie_writer.shutdown()
 
     def last_accepted_block(self) -> Block:

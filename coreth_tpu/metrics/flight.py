@@ -22,6 +22,7 @@ the accepted view over RPC.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -35,6 +36,7 @@ class FlightRecorder:
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self._ring: deque = deque(maxlen=max(1, int(capacity)))
+        self._events: deque = deque(maxlen=max(1, int(capacity)))
         self._lock = threading.Lock()
         self._seq = 0
 
@@ -68,6 +70,30 @@ class FlightRecorder:
         if n is not None:
             recs = recs[-max(0, int(n)):]
         return recs
+
+    def note_event(self, kind: str, **fields) -> Dict[str, object]:
+        """Append one out-of-band lifecycle event (device demotion/
+        re-promotion, mirror quarantine, torn-tail repair, ...) to a ring
+        parallel to the block records, sharing the same seq counter so
+        events interleave with blocks in wall order."""
+        ev = {"event": kind, "ts": time.time()}
+        ev.update(fields)
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            self._events.append(ev)
+        return ev
+
+    def events(self, n: Optional[int] = None,
+               kind: Optional[str] = None) -> List[Dict[str, object]]:
+        """Newest-last list of recent lifecycle events."""
+        with self._lock:
+            evs = list(self._events)
+        if kind is not None:
+            evs = [e for e in evs if e.get("event") == kind]
+        if n is not None:
+            evs = evs[-max(0, int(n)):]
+        return evs
 
     def find(self, block_hash: bytes) -> Optional[Dict[str, object]]:
         with self._lock:

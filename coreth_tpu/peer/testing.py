@@ -17,13 +17,20 @@ Script verbs:
 
 The script consumes one verb per call; after the script is exhausted,
 every later call is "ok" (so a sync eventually completes — loop scripts
-by passing `cycle=True`)."""
+by passing `cycle=True`).
+
+`DisruptiveServer` is the TCP-level counterpart: a TransportServer that
+tracks its live connections so a test can sever them all mid-flight and
+exercise RemotePeer's reconnect-on-broken-pipe path."""
 
 from __future__ import annotations
 
+import socket
 import threading
 import time
 from typing import Callable, List
+
+from .transport import TransportServer
 
 
 class TransportFault(Exception):
@@ -72,3 +79,44 @@ class FaultyTransport:
         if verb == "empty":
             return b""
         raise ValueError(f"unknown fault verb {verb!r}")
+
+
+class DisruptiveServer(TransportServer):
+    """TransportServer that can hard-close every live connection on
+    demand — the wire-level analogue of a peer crash / NAT rebind.
+    Drives RemotePeer's backoff re-dial path in chaos tests."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._conns: List[socket.socket] = []
+        self._conns_lock = threading.Lock()
+        self.severed = 0
+
+    def _serve_conn(self, conn, addr):
+        with self._conns_lock:
+            self._conns.append(conn)
+        try:
+            super()._serve_conn(conn, addr)
+        finally:
+            with self._conns_lock:
+                try:
+                    self._conns.remove(conn)
+                except ValueError:
+                    pass
+
+    def sever_all(self) -> int:
+        """Abort every live connection (RST-ish: shutdown both ways then
+        close). Returns how many were severed."""
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self.severed += len(conns)
+        return len(conns)

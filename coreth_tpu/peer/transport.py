@@ -142,22 +142,51 @@ class TransportServer:
 
 
 class RemotePeer:
-    """Client side of one connection; usable as a Network transport."""
+    """Client side of one connection; usable as a Network transport.
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
-        self.sock = socket.create_connection((host, port), timeout=timeout)
+    A broken pipe no longer kills the peer for good: the dial target is
+    retained, and the next request (or gossip) re-dials with capped
+    exponential backoff + jitter (fault.Backoff), counted in
+    `peer/reconnects`. Requests in flight when the connection died still
+    fail — the wire offers no replay semantics — but the peer object
+    stays usable, matching how AvalancheGo keeps the peer and re-dials
+    under it. reconnect=False restores fail-forever."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 reconnect: bool = True, max_redials: int = 4):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self.reconnect = reconnect
+        self.max_redials = max_redials
         self._wlock = threading.Lock()
         self._next_id = 0
         self._id_lock = threading.Lock()
         self._waiters: Dict[int, "threading.Event"] = {}
         self._responses: Dict[int, bytes] = {}
-        self._dead: Optional[Exception] = None
-        threading.Thread(target=self._read_loop, daemon=True).start()
+        # _conn_lock guards sock/_dead/_gen swaps; _gen invalidates stale
+        # read loops (a late error from a replaced socket must not kill
+        # the fresh connection)
+        self._conn_lock = threading.Lock()
+        self._gen = 0  # guarded-by: _conn_lock
+        self._dead: Optional[Exception] = None  # guarded-by: _conn_lock
+        self._closed = False  # guarded-by: _conn_lock
+        self.sock: Optional[socket.socket] = None
+        with self._conn_lock:
+            self._connect_locked()
 
-    def _read_loop(self):
+    def _connect_locked(self) -> None:  # guarded-by: _conn_lock
+        self.sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout)
+        self._dead = None
+        self._gen += 1
+        threading.Thread(target=self._read_loop,
+                         args=(self.sock, self._gen), daemon=True).start()
+
+    def _read_loop(self, sock, gen: int):
         try:
             while True:
-                kind, req_id, payload = _read_frame(self.sock)
+                kind, req_id, payload = _read_frame(sock)
                 if kind != KIND_RESPONSE:
                     continue
                 ev = self._waiters.get(req_id)
@@ -165,42 +194,117 @@ class RemotePeer:
                     self._responses[req_id] = payload
                     ev.set()
         except (TransportError, OSError) as e:
-            self._dead = e
-            for ev in list(self._waiters.values()):
-                ev.set()
+            self._mark_dead(gen, e)
+
+    def _mark_dead(self, gen: int, e: Exception) -> None:
+        with self._conn_lock:
+            if gen != self._gen:
+                return  # stale loop of an already-replaced socket
+            if self._dead is None:
+                self._dead = e
+        # wake every waiter: their request died with the connection
+        for ev in list(self._waiters.values()):
+            ev.set()
+
+    def _ensure_connected(self) -> None:
+        """Re-dial a dead connection with capped backoff + jitter; raises
+        TransportError when closed, reconnect is off, or every redial
+        attempt failed."""
+        from ..fault import Backoff
+        from ..metrics import default_registry
+
+        with self._conn_lock:
+            if self._closed:
+                raise TransportError("peer closed")
+            if self._dead is None:
+                return
+            if not self.reconnect:
+                raise TransportError(
+                    f"peer connection dead: {self._dead}")
+            last = self._dead
+            backoff = Backoff(base=0.05, cap=2.0)
+            for _ in range(max(1, self.max_redials)):
+                try:
+                    self._connect_locked()
+                except OSError as e:
+                    last = e
+                    backoff.sleep()
+                    continue
+                default_registry.counter("peer/reconnects").inc()
+                return
+            raise TransportError(
+                f"reconnect to {self.host}:{self.port} failed after "
+                f"{self.max_redials} attempts: {last}") from last
 
     def __call__(self, sender_id: bytes, request: bytes) -> bytes:
         """Network transport contract: blocking request/response."""
-        if self._dead is not None:
-            raise TransportError(f"peer connection dead: {self._dead}")
+        self._ensure_connected()
         with self._id_lock:
             self._next_id += 1
             rid = self._next_id
         ev = threading.Event()
         self._waiters[rid] = ev
         try:
+            with self._conn_lock:
+                sock, gen = self.sock, self._gen
             try:
-                _write_frame(self.sock, self._wlock, KIND_REQUEST, rid, request)
+                _write_frame(sock, self._wlock, KIND_REQUEST, rid, request)
             except OSError as e:  # socket died between checks
-                raise TransportError(f"peer connection dead: {e}") from e
-            if not ev.wait(timeout=self.sock.gettimeout()):
+                self._mark_dead(gen, e)
+                # broken pipe surfaces HERE, not in the read loop:
+                # re-dial once and replay this request on the fresh
+                # connection (it never reached the wire)
+                self._ensure_connected()
+                with self._conn_lock:
+                    sock, gen = self.sock, self._gen
+                try:
+                    _write_frame(sock, self._wlock, KIND_REQUEST, rid,
+                                 request)
+                except OSError as e2:
+                    self._mark_dead(gen, e2)
+                    raise TransportError(
+                        f"peer connection dead: {e2}") from e2
+            if not ev.wait(timeout=sock.gettimeout()):
                 raise TransportError("request timed out")
-            if self._dead is not None and rid not in self._responses:
-                raise TransportError(f"peer connection dead: {self._dead}")
+            with self._conn_lock:
+                dead = self._dead
+            if dead is not None and rid not in self._responses:
+                raise TransportError(f"peer connection dead: {dead}")
             return self._responses.pop(rid)
         finally:
             self._waiters.pop(rid, None)
             self._responses.pop(rid, None)
 
     def gossip(self, payload: bytes) -> None:
-        _write_frame(self.sock, self._wlock, KIND_GOSSIP, 0, payload)
+        self._ensure_connected()
+        with self._conn_lock:
+            sock, gen = self.sock, self._gen
+        try:
+            _write_frame(sock, self._wlock, KIND_GOSSIP, 0, payload)
+        except OSError as e:
+            self._mark_dead(gen, e)
+            self._ensure_connected()
+            with self._conn_lock:
+                sock, gen = self.sock, self._gen
+            try:
+                _write_frame(sock, self._wlock, KIND_GOSSIP, 0, payload)
+            except OSError as e2:
+                self._mark_dead(gen, e2)
+                raise TransportError(
+                    f"peer connection dead: {e2}") from e2
 
     def close(self):
-        try:
-            self.sock.close()
-        except OSError:
-            pass
+        with self._conn_lock:
+            self._closed = True
+            self._gen += 1  # retire the read loop's death report
+            sock = self.sock
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
-def dial(host: str, port: int, timeout: float = 30.0) -> RemotePeer:
-    return RemotePeer(host, port, timeout)
+def dial(host: str, port: int, timeout: float = 30.0,
+         reconnect: bool = True) -> RemotePeer:
+    return RemotePeer(host, port, timeout, reconnect=reconnect)

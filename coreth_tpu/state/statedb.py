@@ -498,6 +498,7 @@ class StateDB:
         update loop's update_root() is a cache hit. Unlike the full
         planned path there are no zeroed holes to heal: any failure
         leaves the tries untouched and the per-trie hashers take over."""
+        from ..ops.device import DeviceDegradedError
         from ..trie.node import FullNode, ShortNode
         from ..trie.planned import PlannedGraphBuilder, TooManySegments
 
@@ -519,8 +520,10 @@ class StateDB:
             return
         try:
             builder.run()
-        except TooManySegments:
-            return  # per-trie hashers cover the pathological shape
+        except (TooManySegments, DeviceDegradedError):
+            # pathological shape, or the ladder demoted mid-call: the
+            # per-trie hashers cover it (host-routed once demoted)
+            return
         for obj, handle, tr in pending:
             obj.data.root = builder.digest(handle)
             tr.trie.unhashed = 0
@@ -537,6 +540,7 @@ class StateDB:
         single device dependency chain.
         """
         from ..metrics import expensive_timer
+        from ..ops.device import DeviceDegradedError
         from ..trie.encoding import key_to_hex
         from ..trie.node import FullNode, ShortNode
         from ..trie.planned import PlannedGraphBuilder, TooManySegments
@@ -579,7 +583,10 @@ class StateDB:
                 builder.add_account_trie(inner_acct.root, holes)
                 try:
                     root_hash = builder.run()
-                except TooManySegments:
+                except (TooManySegments, DeviceDegradedError):
+                    # segment overflow, or the ladder demoted the device
+                    # mid-call: heal on host and drain through the level
+                    # hashers below (host-routed once demoted)
                     root_hash = None
                 except BaseException:
                     # a device failure mid-run must NOT leave the account

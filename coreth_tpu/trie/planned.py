@@ -350,8 +350,16 @@ class PlannedGraphBuilder:
             from ..ops.keccak_planned import default_planned_commit
 
             planned = default_planned_commit()
-        _root, dig = planned.run(specs, flat_words, dst, child, shift,
-                                 root_pos, want_digests=True)
+        # the device round-trip runs under the degradation ladder: a
+        # watchdogged/retried dispatch that raises DeviceDegradedError
+        # after demoting — callers fall back to the (host-routed) level
+        # hashers exactly like the TooManySegments escape
+        from ..ops.device import default_ladder
+
+        _root, dig = default_ladder().dispatch(
+            lambda: planned.run(specs, flat_words, dst, child, shift,
+                                root_pos, want_digests=True),
+            "planned device commit")
         with phase_timer("planned/phase/absorb"):
             digs = np.ascontiguousarray(dig).view(np.uint8).reshape(-1, 32)
 

@@ -37,7 +37,14 @@ import functools
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..fault import FailpointError, failpoint
+from ..fault import register as _register_failpoint
 from ..native.mpt import IncrementalTrie
+
+FP_SPOT_CHECK = _register_failpoint(
+    "state/resident/spot_check",
+    "`raise` forces the periodic mirror spot-check to report divergence "
+    "(exercises the quarantine/reboot path without corrupting a trie)")
 
 
 class MirrorError(Exception):
@@ -110,6 +117,10 @@ class ResidentAccountMirror:
 
             executor = ResidentExecutor()
         self.ex = executor  # None in host mode unless the caller passed one
+        # chain hook fired (under the mirror lock) when a device wedge
+        # forces the one-way host takeover; receives the reason string.
+        # Must not call back into mirror methods or take chainmu.
+        self.on_takeover = None
         self._lock = threading.RLock()
         self.trie = IncrementalTrie(items)
         # device-failure takeover (VERDICT r4 #4): a commit the device
@@ -194,6 +205,76 @@ class ResidentAccountMirror:
         # uncertainty
         self._export_degraded = True
         self._dirty_since_export = True
+        if self.on_takeover is not None:
+            try:
+                self.on_takeover(why)
+            except Exception:
+                from ..metrics import count_drop
+
+                count_drop("state/resident/takeover_hook_error")
+
+    @_locked
+    def spot_check(self) -> bool:
+        """Periodic device-vs-host cross-check (chain knob
+        resident_spot_check_interval): verify the device-resident image
+        against the host keccak oracle WITHOUT ending residency. Returns
+        False on divergence — the chain quarantines via reboot_mirror()
+        instead of letting a silently-corrupt mirror feed consensus.
+
+        rehash_host would be the obvious oracle but it one-way pins the
+        trie to host mode, so a PASSING check would still end residency.
+        Instead: settle + read back the device store (watchdogged, like
+        export_to), then export the full node image and check
+        keccak256(node_rlp) == claimed digest for every node on the host,
+        plus the cached applied root appearing in the digest set. Node
+        RLP embeds children digests from the same store, so this
+        transitively verifies the whole device digest chain down from
+        the root. The full export consumes the delta marks, so the next
+        interval flush is degraded to a full image."""
+        import numpy as np
+
+        from ..metrics import default_registry
+        from ..native import keccak256_batch
+        from ..native.mpt import DeviceWedgedError, _run_with_watchdog
+
+        default_registry.counter("state/resident/spot_checks").inc(1)
+        try:
+            failpoint("state/resident/spot_check")
+        except FailpointError:
+            return False  # chaos-forced divergence
+        if self.host_mode or self.trie.num_nodes == 0:
+            return True  # the host oracle already computed these roots
+        try:
+            dev_root = self.trie.commit_resident_timed(
+                self.ex, self.device_timeout)
+            if self.device_timeout is None:
+                store_np = np.asarray(self.ex.store)
+            else:
+                store_np = _run_with_watchdog(
+                    lambda: np.asarray(self.ex.store),
+                    self.device_timeout, "spot-check store readback")
+            self.trie.absorb_store(store_np)
+        except DeviceWedgedError as e:
+            # not a divergence: the ladder's failure mode. Take over like
+            # any wedged commit; the host root is authoritative now.
+            self._take_over_host(str(e))
+            self.trie.commit_cpu(threads=self._cpu_threads)
+            return True
+        digs, blob, off = self.trie.export_nodes(delta=False)
+        self._export_degraded = True
+        self._dirty_since_export = True
+        n = int(digs.shape[0])
+        msgs = [bytes(blob[int(off[i]):int(off[i + 1])]) for i in range(n)]
+        host = keccak256_batch(msgs, threads=self._cpu_threads)
+        claimed = {digs[i].tobytes() for i in range(n)}
+        ok = all(digs[i].tobytes() == host[i] for i in range(n))
+        cached = self._roots.get(self._applied[-1])
+        ok = ok and dev_root in claimed and (
+            cached is None or cached == dev_root)
+        if not ok:
+            default_registry.counter(
+                "state/resident/spot_check_failures").inc(1)
+        return ok
 
     # ---- lifecycle -------------------------------------------------------
 

@@ -236,11 +236,15 @@ class Trie:
             if getattr(self._batch_keccak, "planned", False):
                 # the u32 planned executor: one bulk word transfer,
                 # on-device digest patching, zero byte ops on device
+                from ..ops.device import DeviceDegradedError
                 from .planned import PlannedHasher, TooManySegments
 
                 try:
                     h = PlannedHasher().hash_root(self.root)
-                except TooManySegments:
+                except (TooManySegments, DeviceDegradedError):
+                    # pathological segment shape, or the ladder demoted
+                    # the device mid-call: the level hashers finish the
+                    # same dirty set (host batch keccak when demoted)
                     h = BatchedHasher(self._batch_keccak).hash_root(self.root)
             elif getattr(self._batch_keccak, "fused", False):
                 # single-dispatch commit: one transfer for the whole

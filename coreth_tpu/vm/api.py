@@ -507,10 +507,17 @@ class DebugMetricsAPI:
         self.vm = vm
 
     def metrics(self) -> dict:
-        """debug_metrics: JSON dump of every registered metric."""
+        """debug_metrics: JSON dump of every registered metric, plus the
+        device ladder's status (state, last error, knobs) and any cached
+        device-resolution failure under ops/device/status."""
         from ..metrics import default_registry
+        from ..ops import device
 
-        return default_registry.marshal()
+        out = default_registry.marshal()
+        status = device.default_ladder().status()
+        status["resolve_error"] = device.resolution_error()
+        out["ops/device/status"] = status
+        return out
 
     def blockFlightRecord(self, n: Optional[int] = None,
                           accepted_only: bool = True) -> list:
@@ -545,6 +552,41 @@ class DebugMetricsAPI:
 
         _metrics.enabled_expensive = bool(enabled)
         return _metrics.enabled_expensive
+
+    def setFailpoint(self, name: str, spec: Optional[str] = None) -> list:
+        """debug_setFailpoint: arm failpoint [name] with [spec]
+        ("raise[:msg]" / "hang[:ms]" with optional "%prob" / "*count" —
+        coreth_tpu/fault), or disarm it when spec is empty. Returns the
+        currently-armed list. Unknown names error (the registry is the
+        source of truth; see debug_listFailpoints)."""
+        from .. import fault
+
+        fault.set_failpoint(name, spec or None)
+        return fault.list_armed()
+
+    def listFailpoints(self) -> dict:
+        """debug_listFailpoints: every registered failpoint site with its
+        description, plus the currently-armed specs and fire counts."""
+        from .. import fault
+
+        return {"registered": fault.registered(),
+                "armed": fault.list_armed()}
+
+    def deviceStatus(self) -> dict:
+        """debug_deviceStatus: the degradation ladder's current state
+        (healthy/demoted/probation), last error, and knobs."""
+        from ..ops import device
+
+        status = device.default_ladder().status()
+        status["resolve_error"] = device.resolution_error()
+        return status
+
+    def flightEvents(self, n: Optional[int] = None,
+                     kind: Optional[str] = None) -> list:
+        """debug_flightEvents: out-of-band lifecycle events from the
+        flight recorder (device demotions/re-promotions, mirror
+        takeovers/quarantines, torn-tail repairs), newest last."""
+        return self.vm.blockchain.flight_recorder.events(n=n, kind=kind)
 
 
 def create_handlers(vm, allow_unfinalized_queries: bool = False) -> RPCServer:

@@ -81,6 +81,25 @@ class Config:
     # 0 = auto (env CORETH_TPU_CPU_THREADS, else min(16, cores))
     cpu_threads: int = 0
 
+    # --- robustness (ROBUSTNESS.md: device degradation ladder + tail) ----
+    # per-call watchdog deadline (s) for laddered device dispatches
+    # (planned commit, batched keccak); 0 disables the watchdog
+    device_call_timeout: float = 0.0
+    # transient-error retries (capped backoff) before a dispatch demotes
+    # the device to the bit-exact host path
+    device_max_retries: int = 1
+    # seconds between background health probes while demoted; <= 0 means
+    # demotion is permanent for the process
+    device_probe_interval: float = 5.0
+    # consecutive healthy probes required before re-promotion
+    device_promote_after: int = 3
+    # resident-mirror spot check (device root vs host keccak oracle)
+    # every K committed inserts; divergence quarantines the mirror. 0 off
+    resident_spot_check_interval: int = 0
+    # deadline (s) for insert-tail / acceptor-queue joins; on expiry the
+    # join raises a diagnosable TailStalled instead of hanging. 0 off
+    tail_join_timeout: float = 0.0
+
     # --- tx pool ----------------------------------------------------------
     local_txs_enabled: bool = False
     tx_pool_price_limit: int = 1
@@ -171,6 +190,26 @@ class Config:
         if self.cpu_threads < 0:
             raise ValueError(
                 f"cpu-threads must be >= 0 (got {self.cpu_threads})")
+        if self.device_call_timeout < 0:
+            raise ValueError(
+                f"device-call-timeout must be >= 0 "
+                f"(got {self.device_call_timeout})")
+        if self.device_max_retries < 0:
+            raise ValueError(
+                f"device-max-retries must be >= 0 "
+                f"(got {self.device_max_retries})")
+        if self.device_promote_after <= 0:
+            raise ValueError(
+                f"device-promote-after must be > 0 "
+                f"(got {self.device_promote_after})")
+        if self.resident_spot_check_interval < 0:
+            raise ValueError(
+                f"resident-spot-check-interval must be >= 0 "
+                f"(got {self.resident_spot_check_interval})")
+        if self.tail_join_timeout < 0:
+            raise ValueError(
+                f"tail-join-timeout must be >= 0 "
+                f"(got {self.tail_join_timeout})")
         if self.span_ring_size <= 0:
             raise ValueError(
                 f"span-ring-size must be > 0 (got {self.span_ring_size})")

@@ -209,6 +209,13 @@ class VM:
                 trie_dirty_limit=full.trie_dirty_cache * 1024 * 1024,
                 accepted_cache_size=full.accepted_cache_size,
                 flight_recorder_size=full.flight_recorder_size,
+                device_call_timeout=full.device_call_timeout,
+                device_max_retries=full.device_max_retries,
+                device_probe_interval=full.device_probe_interval,
+                device_promote_after=full.device_promote_after,
+                resident_spot_check_interval=(
+                    full.resident_spot_check_interval),
+                tail_join_timeout=full.tail_join_timeout,
             ),
             self.chain_config,
             genesis,

@@ -29,3 +29,18 @@ assert len(jax.devices()) >= 8, "expected 8 virtual CPU devices for tests"
 from coreth_tpu.utils import enable_compilation_cache  # noqa: E402
 
 enable_compilation_cache()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fault_isolation():
+    """Failpoints and the device ladder are process-global; a test that
+    arms one and fails before clearing it must not poison the rest of
+    the run."""
+    yield
+    from coreth_tpu import fault
+    from coreth_tpu.ops import device
+
+    fault.clear_all()
+    device.default_ladder().reset()

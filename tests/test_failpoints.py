@@ -1,0 +1,203 @@
+"""coreth_tpu.fault: deterministic failpoints + the Backoff primitive.
+
+The conftest autouse fixture clears armed failpoints and resets the
+device ladder after every test, so tests here arm freely.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from coreth_tpu import fault
+from coreth_tpu.fault import Backoff, FailpointError, failpoint
+
+
+def _register_unique(tag, doc=""):
+    """Registry entries are process-global and cannot be unregistered;
+    use per-test unique names so reruns inside one process can't
+    collide."""
+    name = f"test/fault/{tag}/{random.randrange(1 << 48):012x}"
+    return fault.register(name, doc)
+
+
+class TestRegistry:
+    def test_register_and_list(self):
+        name = _register_unique("listed", "docstring here")
+        assert fault.registered()[name] == "docstring here"
+
+    def test_duplicate_registration_raises(self):
+        name = _register_unique("dup")
+        with pytest.raises(ValueError, match="registered twice"):
+            fault.register(name)
+
+    def test_arming_unregistered_name_raises(self):
+        with pytest.raises(KeyError, match="unknown failpoint"):
+            fault.set_failpoint("test/fault/never-registered", "raise")
+
+    def test_disarmed_is_free(self):
+        name = _register_unique("noop")
+        assert fault.enabled is False
+        failpoint(name)  # must be a no-op, not a KeyError
+
+
+class TestFiring:
+    def test_raise_verb(self):
+        name = _register_unique("raise")
+        fault.set_failpoint(name, "raise")
+        assert fault.enabled is True
+        with pytest.raises(FailpointError) as ei:
+            failpoint(name)
+        assert ei.value.failpoint == name
+
+    def test_raise_with_message(self):
+        name = _register_unique("raise-msg")
+        fault.set_failpoint(name, "raise:injected boom")
+        with pytest.raises(FailpointError, match="injected boom"):
+            failpoint(name)
+
+    def test_count_budget(self):
+        name = _register_unique("count")
+        fault.set_failpoint(name, "raise*2")
+        for _ in range(2):
+            with pytest.raises(FailpointError):
+                failpoint(name)
+        failpoint(name)  # budget exhausted: a no-op
+        armed = [a for a in fault.list_armed() if a["name"] == name]
+        assert armed[0]["fired"] == 2
+        assert armed[0]["remaining"] == 0
+
+    def test_probability_is_deterministic(self):
+        """Same seed -> identical fire pattern; chaos runs must replay."""
+        name = _register_unique("prob")
+
+        def pattern(seed):
+            fault.set_seed(seed)
+            fault.set_failpoint(name, "raise%0.5")
+            fired = []
+            for _ in range(32):
+                try:
+                    failpoint(name)
+                    fired.append(False)
+                except FailpointError:
+                    fired.append(True)
+            fault.set_failpoint(name, None)
+            return fired
+
+        a, b = pattern(1234), pattern(1234)
+        c = pattern(99)
+        fault.set_seed(0)
+        assert a == b
+        assert a != c  # overwhelmingly likely for 32 Bernoulli draws
+        assert any(a) and not all(a)
+
+    def test_hang_ms_then_continue(self):
+        name = _register_unique("hang-ms")
+        fault.set_failpoint(name, "hang:30")
+        t0 = time.monotonic()
+        failpoint(name)
+        assert time.monotonic() - t0 >= 0.025
+
+    def test_hang_until_disarmed(self):
+        name = _register_unique("hang")
+        fault.set_failpoint(name, "hang")
+        released = threading.Event()
+
+        def park():
+            failpoint(name)
+            released.set()
+
+        t = threading.Thread(target=park, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        assert not released.is_set()  # parked
+        fault.clear_all()
+        assert released.wait(5)
+        t.join(5)
+
+    def test_disarm_with_none(self):
+        name = _register_unique("disarm")
+        fault.set_failpoint(name, "raise")
+        fault.set_failpoint(name, None)
+        failpoint(name)
+        assert fault.enabled is False
+
+
+class TestSpecParsing:
+    def test_bad_verb(self):
+        name = _register_unique("badverb")
+        with pytest.raises(ValueError, match="unknown verb"):
+            fault.set_failpoint(name, "explode")
+
+    def test_bad_prob(self):
+        name = _register_unique("badprob")
+        with pytest.raises(ValueError, match="prob"):
+            fault.set_failpoint(name, "raise%1.5")
+
+    def test_bad_count(self):
+        name = _register_unique("badcount")
+        with pytest.raises(ValueError, match="count"):
+            fault.set_failpoint(name, "raise*0")
+
+    def test_hang_arg_validated_at_arm_time(self):
+        name = _register_unique("badhang")
+        with pytest.raises(ValueError):
+            fault.set_failpoint(name, "hang:not-a-number")
+
+    def test_combined_spec(self):
+        name = _register_unique("combined")
+        fault.set_failpoint(name, "raise:msg%1.0*1")
+        with pytest.raises(FailpointError, match="msg"):
+            failpoint(name)
+        failpoint(name)  # count exhausted
+
+
+class TestEnvParsing:
+    def test_env_arming_in_subprocess(self):
+        """Env specs are parsed at fault-module import, before site
+        registration — the kill-injection path."""
+        import subprocess
+        import sys
+
+        code = (
+            "from coreth_tpu import fault\n"
+            "assert fault.enabled\n"
+            "armed = {a['name']: a['spec'] for a in fault.list_armed()}\n"
+            "assert armed == {'x/one': 'raise', 'x/two': 'hang:5'}, armed\n"
+            "print('OK')\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env={"PATH": "/usr/bin:/bin",
+                 "CORETH_TPU_FAILPOINTS": "x/one=raise; x/two=hang:5"},
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "OK" in out.stdout
+
+
+class TestBackoff:
+    def test_growth_and_cap(self):
+        b = Backoff(base=0.1, factor=2.0, cap=0.5, jitter=0.0)
+        assert [round(b.next_delay(), 6) for _ in range(5)] == \
+            [0.1, 0.2, 0.4, 0.5, 0.5]
+
+    def test_reset(self):
+        b = Backoff(base=0.1, factor=2.0, cap=10.0, jitter=0.0)
+        b.next_delay()
+        b.next_delay()
+        b.reset()
+        assert b.next_delay() == pytest.approx(0.1)
+
+    def test_jitter_bounds(self):
+        b = Backoff(base=1.0, factor=1.0, cap=1.0, jitter=0.25,
+                    rng=random.Random(7))
+        for _ in range(100):
+            assert 0.75 <= b.next_delay() <= 1.25
+
+    def test_sleep_returns_delay(self):
+        b = Backoff(base=0.01, factor=1.0, cap=0.01, jitter=0.0)
+        t0 = time.monotonic()
+        d = b.sleep()
+        assert d == pytest.approx(0.01)
+        assert time.monotonic() - t0 >= 0.008

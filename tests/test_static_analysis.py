@@ -270,6 +270,109 @@ def test_sa005_quiet_on_sorted_iteration():
             if f.rule == "SA005"] == []
 
 
+# ---------------------------------------------------------------- SA006
+
+def _check_many(srcs):
+    """Run several fixture files through ONE engine (SA006 keeps
+    cross-file registration state) and include the finalize() pass."""
+    eng = Engine(default_rules())
+    out = []
+    for src, relpath in srcs:
+        out.extend(eng.check_source(textwrap.dedent(src), relpath))
+    for rule in eng.rules:
+        out.extend(rule.finalize())
+    return [f for f in out if f.rule == "SA006"]
+
+
+def test_sa006_fires_on_computed_failpoint_name():
+    src = """
+    from coreth_tpu.fault import failpoint
+
+    def tick(name):
+        failpoint("prefix/" + name)
+    """
+    out = _check_many([(src, "coreth_tpu/fixture.py")])
+    assert any("literal string name" in f.message for f in out)
+
+
+def test_sa006_fires_on_function_scope_registration():
+    src = """
+    from coreth_tpu.fault import register
+
+    def setup():
+        register("x/inside", "late")
+    """
+    out = _check_many([(src, "coreth_tpu/fixture.py")])
+    assert any("module scope" in f.message for f in out)
+
+
+def test_sa006_fires_on_cross_file_duplicate_registration():
+    a = """
+    from coreth_tpu.fault import register
+    register("x/dup", "first")
+    """
+    b = """
+    from coreth_tpu.fault import register
+    register("x/dup", "second")
+    """
+    out = _check_many([(a, "coreth_tpu/a.py"), (b, "coreth_tpu/b.py")])
+    assert len(out) == 1
+    assert "already registered at coreth_tpu/a.py" in out[0].message
+
+
+def test_sa006_finalize_fires_on_never_registered_name():
+    src = """
+    from coreth_tpu.fault import failpoint
+
+    def tick():
+        failpoint("x/ghost")
+    """
+    out = _check_many([(src, "coreth_tpu/fixture.py")])
+    assert any("no module registers" in f.message for f in out)
+
+
+def test_sa006_quiet_on_registered_literal_round_trip():
+    """Module-scope register + literal fire (even across files, even
+    through a module alias) is the sanctioned shape."""
+    a = """
+    from coreth_tpu.fault import register
+    register("x/ok", "docs")
+    """
+    b = """
+    from coreth_tpu import fault as flt
+
+    def tick():
+        flt.failpoint("x/ok")
+    """
+    assert _check_many([(a, "coreth_tpu/a.py"), (b, "coreth_tpu/b.py")]) == []
+
+
+@pytest.mark.parametrize("body", [
+    "time.sleep(0.1)",
+    "sleep(0.1)",
+])
+def test_sa006_fires_on_naked_sleep(body):
+    src = f"""
+    import time
+    from time import sleep
+
+    def retry():
+        {body}
+    """
+    out = _check_many([(src, "coreth_tpu/peer/fixture.py")])
+    assert any("fault.Backoff" in f.message for f in out)
+
+
+def test_sa006_sleep_allowed_inside_fault_package():
+    src = """
+    import time
+
+    def _pace(self):
+        time.sleep(0.1)
+    """
+    assert _check_many([(src, "coreth_tpu/fault/__init__.py")]) == []
+
+
 # ------------------------------------------------------------ repo gate
 
 def test_repo_is_clean_modulo_baseline():
