@@ -18,6 +18,9 @@ SA006 failpoint-hygiene  failpoint names are unique string literals
                        registered at module import; `failpoint()` only
                        fires registered names; no naked `time.sleep`
                        outside coreth_tpu/fault/ (use fault.Backoff)
+SA007 serving-bounded  no unbounded `queue.Queue()` / `SimpleQueue()` or
+                       un-capped `ThreadPoolExecutor()` in serving-path
+                       modules — bounded queues ARE the admission control
 """
 
 from __future__ import annotations
@@ -711,9 +714,118 @@ class FailpointHygieneRule(Rule):
                     f"KeyError; add a module-scope register()")
 
 
+# ------------------------------------------------------------------ SA007
+
+# The serving tier's overload story *is* its bounded queues (PR 7,
+# ROBUSTNESS.md "Serving under overload"): an unbounded queue or an
+# uncapped worker source in a request-serving module quietly
+# reintroduces collapse-under-saturation. Client-side and batch code is
+# out of scope — only modules that accept remote work are listed.
+SERVING_PATHS = (
+    "coreth_tpu/rpc/",
+    "coreth_tpu/vm/api.py",
+    "coreth_tpu/eth/filters.py",
+    "coreth_tpu/metrics/http.py",
+)
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue"}
+
+
+class ServingBoundednessRule(Rule):
+    """Serving-path modules must construct only *bounded* work buffers:
+    `queue.Queue()` with no maxsize (or maxsize=0) is unbounded, as is
+    `SimpleQueue()`; a `ThreadPoolExecutor()` without max_workers sizes
+    itself from the host, not from an admission budget. Genuinely
+    justified cases go in the baseline with a reason."""
+
+    id = "SA007"
+    title = "unbounded queue/executor in serving path"
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        if not any(src.relpath == p or src.relpath.startswith(p)
+                   for p in SERVING_PATHS):
+            return iter(())
+        rule = self
+        findings: List[Finding] = []
+        queue_names: Set[str] = set()   # bare names bound to queue ctors
+        simple_names: Set[str] = set()  # bare names for SimpleQueue
+        exec_names: Set[str] = set()    # bare names for ThreadPoolExecutor
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod == "queue":
+                    for a in node.names:
+                        if a.name in _QUEUE_CTORS:
+                            queue_names.add(a.asname or a.name)
+                        elif a.name == "SimpleQueue":
+                            simple_names.add(a.asname or a.name)
+                elif mod == "concurrent.futures":
+                    for a in node.names:
+                        if a.name == "ThreadPoolExecutor":
+                            exec_names.add(a.asname or a.name)
+
+        def kind_of(call: ast.Call) -> Optional[str]:
+            name = dotted(call.func)
+            if name is None:
+                return None
+            head, _, _ = name.partition(".")
+            last = name.split(".")[-1]
+            if name in queue_names or (head == "queue"
+                                       and last in _QUEUE_CTORS):
+                return "queue"
+            if name in simple_names or (head == "queue"
+                                        and last == "SimpleQueue"):
+                return "simple"
+            if name in exec_names or last == "ThreadPoolExecutor":
+                return "executor"
+            return None
+
+        def bound_arg(call: ast.Call, kw: str) -> Optional[ast.AST]:
+            if call.args:
+                return call.args[0]
+            for k in call.keywords:
+                if k.arg == kw:
+                    return k.value
+            return None
+
+        class V(QualnameVisitor):
+            def visit_Call(self, node: ast.Call) -> None:
+                kind = kind_of(node)
+                if kind == "simple":
+                    findings.append(rule.finding(
+                        src, node, self.qualname,
+                        "SimpleQueue is always unbounded — serving paths "
+                        "use a bounded queue.Queue(maxsize=...) so a full "
+                        "buffer sheds instead of growing"))
+                elif kind == "queue":
+                    arg = bound_arg(node, "maxsize")
+                    unbounded = arg is None or (
+                        isinstance(arg, ast.Constant) and arg.value == 0)
+                    if unbounded:
+                        findings.append(rule.finding(
+                            src, node, self.qualname,
+                            "unbounded queue in a serving module "
+                            "(maxsize absent or 0) — bounded admission "
+                            "queues are the overload control; pass a "
+                            "positive maxsize or baseline with a reason"))
+                elif kind == "executor":
+                    arg = bound_arg(node, "max_workers")
+                    if arg is None or (isinstance(arg, ast.Constant)
+                                       and arg.value is None):
+                        findings.append(rule.finding(
+                            src, node, self.qualname,
+                            "ThreadPoolExecutor without max_workers sizes "
+                            "itself from the host — serving-path "
+                            "concurrency comes from an explicit budget"))
+                self.generic_visit(node)
+
+        V().visit(src.tree)
+        return iter(findings)
+
+
 ALL_RULES: Tuple[type, ...] = (
     SilentExceptRule, LockDisciplineRule, HotPathPurityRule,
     ConsensusFloatRule, UnorderedIterationRule, FailpointHygieneRule,
+    ServingBoundednessRule,
 )
 
 

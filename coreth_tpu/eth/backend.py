@@ -30,11 +30,13 @@ def require_keystore(keystore):
 
 class EthBackend:
     def __init__(self, chain, txpool, allow_unfinalized_queries: bool = False,
-                 keystore=None, external_signer=None):
+                 keystore=None, external_signer=None, api_max_blocks: int = 0):
         self.chain = chain
         self.txpool = txpool
         self.chain_config = chain.config
         self.allow_unfinalized_queries = allow_unfinalized_queries
+        # eth_getLogs block-span cap (api-max-blocks-per-request); 0 = off
+        self.api_max_blocks = api_max_blocks
         self.keystore = keystore  # accounts.KeyStore | None (node/ role)
         # accounts/external.ExternalSigner | None (clef daemon): its
         # accounts list into eth_accounts; signing for them routes over

@@ -11,8 +11,13 @@ from typing import Callable, Dict, List, Optional
 
 from ..core.types import bloom_lookup
 from ..metrics import count_drop
+from ..utils.deadline import check as deadline_check
 
 FILTER_TIMEOUT = 300.0  # 5 min deactivation like filter_system.go
+
+# deadline checkpoint cadence inside a block scan: often enough that a
+# budget overrun is caught within one batch, rare enough to stay free
+DEADLINE_CHECK_EVERY = 32
 
 
 def _match_topics(log, topics: List) -> bool:
@@ -219,6 +224,14 @@ class FilterSystem:
         lo = crit["from"] if crit["from"] is not None else head
         hi = crit["to"] if crit["to"] is not None else head
         hi = min(hi, head)
+        max_blocks = getattr(self.b, "api_max_blocks", 0)
+        if max_blocks and hi - lo + 1 > max_blocks:
+            from ..rpc.server import RPCError
+            from ..rpc.admission import LIMIT_EXCEEDED
+            raise RPCError(
+                LIMIT_EXCEEDED,
+                f"eth_getLogs range too large ({hi - lo + 1} > "
+                f"{max_blocks} blocks); narrow fromBlock/toBlock")
 
         from ..core.bloom_index import filter_groups
 
@@ -227,6 +240,7 @@ class FilterSystem:
         out = []
         n = lo
         while n <= hi:
+            deadline_check()  # cooperative: frees the worker on expiry
             size = indexer.section_size if indexer else 0
             section = n // size if size else 0
             sec_lo, sec_hi = section * size, (section + 1) * size - 1
@@ -257,7 +271,9 @@ class FilterSystem:
     def _scan_blocks(self, blocks, crit: dict) -> list:
         chain = self.b.chain
         out = []
-        for blk in blocks:
+        for i, blk in enumerate(blocks):
+            if i % DEADLINE_CHECK_EVERY == 0:
+                deadline_check()
             if blk is None:
                 continue
             # bloom pre-filter: skip blocks that cannot contain a match

@@ -15,6 +15,7 @@ from ..core.state_transition import GasPool
 from ..core.types import Signer
 from ..evm.evm import EVM, Config, TxContext
 from ..rpc.server import RPCError
+from ..utils.deadline import check as deadline_check
 from .api import hb, hx, parse_bytes
 from .tracer_dsl import DSLTracer
 
@@ -243,6 +244,7 @@ class DebugAPI:
         gp = GasPool(blk.gas_limit)
         results = []
         for i, tx in enumerate(blk.transactions):
+            deadline_check()  # replay is per-tx expensive: checkpoint each
             traced = upto_index is None or i == upto_index
             tracer = tracer_factory() if traced else None
             tx_state, cfg, finish_evm = self._attach_tracer(tracer, state)
@@ -299,6 +301,7 @@ class DebugAPI:
         gp = GasPool(blk.gas_limit)
         pre = []  # (pre_state_copy, gas_left)
         for i, tx in enumerate(blk.transactions):
+            deadline_check()
             pre.append((state.copy(), gp.gas))
             block_ctx = new_block_context(blk.header, chain)
             evm = EVM(block_ctx, TxContext(), state, self.b.chain_config,

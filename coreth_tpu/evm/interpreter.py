@@ -21,6 +21,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from .. import vmerrs
 from ..metrics import default_registry as _metrics
 from ..native import keccak256
+from ..utils.deadline import check as deadline_check
 from . import gas as G
 from . import opcodes as OP
 
@@ -1652,6 +1653,10 @@ class Interpreter:
         """Execute the contract; raises vmerrs.VMError on failure. A raised
         ErrExecutionReverted carries .revert_data with the reason bytes."""
         evm = self.evm
+        # Cooperative RPC deadline checkpoint at frame entry only: gas
+        # bounds one frame, the frame boundary bounds a call tree. The
+        # step loops below stay clock-free (SA003 # hot-path).
+        deadline_check()
         # restore-on-exit frame state (the Go version allocates a fresh
         # interpreter frame; we reuse one object and save/restore)
         saved = (self.read_only, self.return_data, self.pc)

@@ -5,6 +5,15 @@ Method registry keyed `namespace_method`, single + batch dispatch,
 standard error codes, and pub/sub subscriptions. Serves over HTTP via the
 stdlib ThreadingHTTPServer (handlers.go equivalents); tests can dispatch
 in-process through `handle_raw`.
+
+Overload behavior (ROBUSTNESS.md "Serving under overload"): when built
+with a `ServingPolicy` (vm/api.create_handlers wires one from config),
+dispatch runs on bounded cheap/expensive worker lanes, sheds `-32005`
+(HTTP 429 + Retry-After) when a lane saturates, enforces cooperative
+per-request deadlines, routes expensive methods through a circuit
+breaker, and `stop()` drains in-flight work up to `rpc-drain-timeout`
+before reporting what it abandoned. A bare `RPCServer()` (no policy)
+dispatches inline exactly as the seed did.
 """
 
 from __future__ import annotations
@@ -15,11 +24,26 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional
 
+from ..fault import failpoint, register
+from ..metrics import default_registry
+from ..utils.deadline import Deadline, DeadlineExceeded
+from ..utils.deadline import scope as _deadline_scope
+from .admission import (ABANDONED, LIMIT_EXCEEDED, TIMEOUT_ERROR,
+                        ServingPolicy, Shed, is_expensive)
+
 PARSE_ERROR = -32700
 INVALID_REQUEST = -32600
 METHOD_NOT_FOUND = -32601
 INVALID_PARAMS = -32602
 INTERNAL_ERROR = -32603
+
+# Overload/slow-handler drills (tests, CORETH_TPU_FAILPOINTS): `hang`
+# here parks a worker exactly like a wedged handler would.
+register("rpc/before_dispatch",
+         "before every RPC handler invocation (on the serving worker)")
+register("rpc/before_dispatch_expensive",
+         "before expensive-lane handlers only (eth_call/eth_getLogs/"
+         "debug_trace*), after the generic before_dispatch point")
 
 
 class RPCError(Exception):
@@ -38,12 +62,14 @@ class Subscription:
 
 
 class RPCServer:
-    def __init__(self):
+    def __init__(self, policy: Optional[ServingPolicy] = None):
         self._methods: Dict[str, Callable] = {}
         self._subscriptions: Dict[str, Subscription] = {}
         self._sub_factories: Dict[str, Callable] = {}
         self.lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
+        self._ipc_stops: List[Callable[[], None]] = []
+        self.policy = policy
 
     # --- registration -----------------------------------------------------
 
@@ -72,7 +98,21 @@ class RPCServer:
 
     # --- dispatch ---------------------------------------------------------
 
-    def handle_raw(self, raw: bytes) -> bytes:
+    def handle_raw(self, raw: bytes, meta: Optional[dict] = None) -> bytes:
+        """Dispatch one wire payload. [meta], when given, receives
+        transport hints: `status` (429/503/413) + `retry_after` when the
+        whole payload was shed, so HTTP can answer with the right code
+        while IPC/WS just relay the JSON error object."""
+        policy = self.policy
+        if (policy is not None and policy.body_limit
+                and len(raw) > policy.body_limit):
+            default_registry.counter("rpc/body_oversize").inc()
+            if meta is not None:
+                meta["status"] = 413
+            return self._encode_error(
+                None, INVALID_REQUEST,
+                f"request body too large "
+                f"({len(raw)} > {policy.body_limit} bytes)")
         try:
             payload = json.loads(raw)
         except Exception:
@@ -80,11 +120,30 @@ class RPCServer:
         if isinstance(payload, list):
             if not payload:
                 return self._encode_error(None, INVALID_REQUEST, "empty batch")
-            out = [self._handle_one(req) for req in payload]
+            if (policy is not None and policy.batch_limit
+                    and len(payload) > policy.batch_limit):
+                default_registry.counter("rpc/batch_oversize").inc()
+                return self._encode_error(
+                    None, INVALID_REQUEST,
+                    f"batch too large "
+                    f"({len(payload)} > {policy.batch_limit} requests)")
+            out = [self._handle_one(req, meta) for req in payload]
+            self._finish_meta(meta, len(payload))
             return json.dumps([json.loads(o) for o in out if o]).encode()
-        return self._handle_one(payload)
+        resp = self._handle_one(payload, meta)
+        self._finish_meta(meta, 1)
+        return resp
 
-    def _handle_one(self, req) -> bytes:
+    @staticmethod
+    def _finish_meta(meta: Optional[dict], total: int) -> None:
+        """Fully-shed payloads surface as HTTP 429 (503 while draining —
+        set at shed time); partial batch sheds stay 200 with per-item
+        error objects, standard JSON-RPC batch semantics."""
+        if meta is not None and meta.get("sheds", 0) >= total:
+            meta.setdefault("status", 429)
+            meta.setdefault("retry_after", 1)
+
+    def _handle_one(self, req, meta: Optional[dict] = None) -> bytes:
         if not isinstance(req, dict):
             return self._encode_error(None, INVALID_REQUEST, "invalid request")
         req_id = req.get("id")
@@ -97,23 +156,109 @@ class RPCServer:
             return self._encode_error(
                 req_id, METHOD_NOT_FOUND, f"the method {method} does not exist"
             )
+        policy = self.policy
+        if policy is None:
+            return self._run_handler(req_id, method, fn, params, None)[0]
+        lane = policy.lane(method)
+        deadline = None
+        budget = policy.budget_for(method)
+        if budget > 0:
+            # the budget covers queue wait + execution: bounded latency,
+            # not just bounded run time
+            deadline = Deadline(budget)
+        if lane is None:
+            return self._run_handler(req_id, method, fn, params, deadline)[0]
+        return self._dispatch_pooled(req_id, method, fn, params, lane,
+                                     deadline, meta)
+
+    def _dispatch_pooled(self, req_id, method, fn, params, lane, deadline,
+                         meta: Optional[dict]) -> bytes:
+        policy = self.policy
+        expensive = lane is policy.expensive_pool
+        probe = False
+        if expensive:
+            verdict = policy.breaker.admit()
+            if verdict == "shed":
+                self._count_shed(method, "breaker", meta)
+                return self._encode_error(
+                    req_id, LIMIT_EXCEEDED,
+                    "circuit breaker open: expensive methods are "
+                    "timing out; retry later")
+            probe = verdict == "probe"
         try:
+            fut = lane.submit(
+                method,
+                lambda: self._run_handler(req_id, method, fn, params,
+                                          deadline))
+        except Shed as s:
+            self._count_shed(method, s.reason, meta)
+            code = TIMEOUT_ERROR if s.reason == "draining" else LIMIT_EXCEEDED
+            return self._encode_error(req_id, code, str(s))
+        # Cooperative handlers answer by their deadline; the wait backstop
+        # only catches a handler that never reaches a checkpoint (its
+        # worker stays lost until it returns — threads cannot be killed).
+        wait_timeout = None
+        if deadline is not None:
+            wait_timeout = (deadline.remaining()
+                            + max(1.0, 2.0 * deadline.budget))
+        done, value = fut.wait(wait_timeout)
+        if not done:
+            default_registry.counter("rpc/timeout").inc()
+            default_registry.counter("rpc/stuck_workers").inc()
+            if expensive:
+                policy.breaker.record(True, probe)
+            return self._encode_error(
+                req_id, TIMEOUT_ERROR,
+                f"request exceeded its {deadline.budget:g}s budget "
+                f"(handler missed every deadline checkpoint)")
+        if value is ABANDONED:
+            return self._encode_error(
+                req_id, TIMEOUT_ERROR,
+                "server shut down before the request was served")
+        resp, timed_out = value
+        if expensive:
+            policy.breaker.record(timed_out, probe)
+        return resp
+
+    def _run_handler(self, req_id, method, fn, params, deadline):
+        """Invoke one handler (inline or on a lane worker).
+        -> (response bytes, timed_out)."""
+        try:
+            failpoint("rpc/before_dispatch")
+            if is_expensive(method):
+                failpoint("rpc/before_dispatch_expensive")
             from ..metrics.spans import span
 
             with span("rpc/" + method):
-                if isinstance(params, dict):
-                    result = fn(**params)
-                else:
-                    result = fn(*params)
+                with _deadline_scope(deadline):
+                    if deadline is not None:
+                        deadline.check()  # shed queue-expired work unrun
+                    if isinstance(params, dict):
+                        result = fn(**params)
+                    else:
+                        result = fn(*params)
+        except DeadlineExceeded as e:
+            default_registry.counter("rpc/timeout").inc()
+            return self._encode_error(req_id, TIMEOUT_ERROR, str(e)), True
         except RPCError as e:
-            return self._encode_error(req_id, e.code, str(e), e.data)
+            return self._encode_error(req_id, e.code, str(e), e.data), False
         except TypeError as e:
-            return self._encode_error(req_id, INVALID_PARAMS, str(e))
+            return self._encode_error(req_id, INVALID_PARAMS, str(e)), False
         except Exception as e:
-            return self._encode_error(req_id, INTERNAL_ERROR, str(e))
+            return self._encode_error(req_id, INTERNAL_ERROR, str(e)), False
         return json.dumps(
             {"jsonrpc": "2.0", "id": req_id, "result": result}
-        ).encode()
+        ).encode(), False
+
+    @staticmethod
+    def _count_shed(method: str, reason: str, meta: Optional[dict]) -> None:
+        default_registry.counter("rpc/shed").inc()
+        default_registry.counter(f"rpc/shed/{reason}").inc()
+        if meta is not None:
+            meta["sheds"] = meta.get("sheds", 0) + 1
+            if reason == "draining":
+                meta["status"] = 503
+                meta["retry_after"] = 1
 
     @staticmethod
     def _encode_error(req_id, code: int, message: str, data=None) -> bytes:
@@ -167,22 +312,80 @@ class RPCServer:
     def serve_http(self, host: str = "127.0.0.1", port: int = 0) -> int:
         """Start the HTTP listener; returns the bound port."""
         server = self
+        policy = self.policy
+        conn_sem = (threading.BoundedSemaphore(policy.max_connections)
+                    if policy is not None and policy.max_connections > 0
+                    else None)
 
         class Handler(BaseHTTPRequestHandler):
-            def do_POST(self):
-                length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(length)
-                resp = server.handle_raw(body)
-                self.send_response(200)
+            def _respond(self, status: int, resp: bytes,
+                         retry_after=None, close=False):
+                self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(resp)))
+                if retry_after is not None:
+                    self.send_header("Retry-After", str(retry_after))
+                if close:
+                    self.send_header("Connection", "close")
                 self.end_headers()
                 self.wfile.write(resp)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                if (policy is not None and policy.body_limit
+                        and length > policy.body_limit):
+                    # reject on the declared length: never buffer a body
+                    # the policy already rules out
+                    default_registry.counter("rpc/body_oversize").inc()
+                    self._respond(
+                        413,
+                        server._encode_error(
+                            None, INVALID_REQUEST,
+                            f"request body too large "
+                            f"({length} > {policy.body_limit} bytes)"),
+                        close=True)
+                    return
+                body = self.rfile.read(length)
+                meta: dict = {}
+                resp = server.handle_raw(body, meta)
+                self._respond(meta.get("status", 200), resp,
+                              meta.get("retry_after"))
 
             def log_message(self, *args):
                 pass
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        class _Srv(ThreadingHTTPServer):
+            # hard cap on concurrent connections: past it the socket is
+            # answered 429 inline instead of spawning a thread
+            def process_request(self, request, client_address):
+                if conn_sem is not None and not conn_sem.acquire(
+                        blocking=False):
+                    default_registry.counter("rpc/shed").inc()
+                    default_registry.counter("rpc/shed/connections").inc()
+                    try:
+                        request.sendall(
+                            b"HTTP/1.1 429 Too Many Requests\r\n"
+                            b"Retry-After: 1\r\nContent-Length: 0\r\n"
+                            b"Connection: close\r\n\r\n")
+                    except OSError:
+                        pass  # client gone: the 429 had no audience
+                    self.shutdown_request(request)
+                    return
+                try:
+                    super().process_request(request, client_address)
+                except BaseException:
+                    if conn_sem is not None:
+                        conn_sem.release()
+                    raise
+
+            def process_request_thread(self, request, client_address):
+                try:
+                    super().process_request_thread(request, client_address)
+                finally:
+                    if conn_sem is not None:
+                        conn_sem.release()
+
+        self._httpd = _Srv((host, port), Handler)
         thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         thread.start()
         return self._httpd.server_address[1]
@@ -192,9 +395,8 @@ class RPCServer:
     def serve_ipc(self, path: str):
         """Unix-domain-socket endpoint (rpc/ipc.go): newline-delimited
         JSON-RPC, one connection per client, served on daemon threads.
-        Returns a stop() callable."""
+        Returns a stop() callable (also invoked by RPCServer.stop())."""
         import os
-        import socket
         import socketserver
 
         try:
@@ -202,14 +404,30 @@ class RPCServer:
         except OSError:
             pass
         server = self
+        policy = self.policy
+        body_limit = policy.body_limit if policy is not None else 0
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
-                for line in self.rfile:
-                    line = line.strip()
+                while True:
+                    # bounded readline: an endless unterminated line must
+                    # not buffer past the body cap
+                    line = self.rfile.readline(
+                        body_limit + 2 if body_limit else -1)
                     if not line:
+                        return
+                    payload = line.rstrip(b"\r\n")
+                    if body_limit and len(payload) > body_limit:
+                        default_registry.counter("rpc/body_oversize").inc()
+                        self.wfile.write(server._encode_error(
+                            None, INVALID_REQUEST,
+                            f"request body too large "
+                            f"(> {body_limit} bytes)") + b"\n")
+                        self.wfile.flush()
+                        return  # the stream is mid-line: resync is a new conn
+                    if not payload:
                         continue
-                    resp = server.handle_raw(line)
+                    resp = server.handle_raw(payload)
                     self.wfile.write(resp + b"\n")
                     self.wfile.flush()
 
@@ -228,9 +446,29 @@ class RPCServer:
             except OSError:
                 pass
 
+        self._ipc_stops.append(stop)
         return stop
 
-    def stop(self) -> None:
+    # --- shutdown ---------------------------------------------------------
+
+    def serving_status(self) -> dict:
+        """Live admission/breaker/drain state (debug_rpcStatus)."""
+        if self.policy is None:
+            return {"pooled": False}
+        return self.policy.status()
+
+    def stop(self, drain_timeout: Optional[float] = None) -> dict:
+        """Stop accepting (HTTP + every IPC endpoint), drain in-flight
+        dispatches up to [drain_timeout] (default: the rpc-drain-timeout
+        knob), then report what was abandoned:
+        {"drained": bool, "abandoned": n, "abandoned_methods": [...]}."""
         if self._httpd is not None:
             self._httpd.shutdown()
+            self._httpd.server_close()
             self._httpd = None
+        ipc_stops, self._ipc_stops = self._ipc_stops, []
+        for stop_ipc in ipc_stops:
+            stop_ipc()
+        if self.policy is None:
+            return {"drained": True, "abandoned": 0, "abandoned_methods": []}
+        return self.policy.drain(drain_timeout)

@@ -14,6 +14,14 @@ and every subscription a connection holds is torn down when it closes
 bucket throttles message processing — the reference's WS CPU limiter
 (plugin/evm/vm.go:1178-1186, ws-cpu-refill-rate / ws-cpu-max-stored).
 
+Backpressure (ROBUSTNESS.md "Serving under overload"): when
+`notify_queue_size` > 0, notifications go through a bounded per-client
+queue drained by a dedicated writer thread; a client that stops reading
+fills its queue and is *disconnected deterministically*
+(`rpc/ws/slow_disconnects`) instead of blocking the producer — one
+stalled subscriber can never wedge block acceptance. `max_payload`
+bounds inbound frames (the websocket half of the rpc-body-limit cap).
+
 `WSClient` is the in-repo test/tooling client (role of the reference's
 rpc.DialWebsocket for its own tests).
 """
@@ -24,13 +32,23 @@ import base64
 import hashlib
 import json
 import os
+import queue
 import socket
 import struct
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..fault import failpoint, register
+from ..metrics import count_drop, default_registry
+
 _GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+# `hang` here parks the notification writer — a deterministic stand-in
+# for a client that stopped reading (no TCP buffer games needed).
+register("ws/before_notify",
+         "in the per-connection writer thread, before each subscription "
+         "notification frame is written")
 
 OP_TEXT = 0x1
 OP_CLOSE = 0x8
@@ -54,8 +72,19 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
-def read_frame(sock: socket.socket) -> Tuple[int, bytes]:
-    """-> (opcode, payload); handles fragmentation by concatenation."""
+class FrameTooLarge(ConnectionError):
+    """An inbound frame exceeded [max_payload] — raised *before* the
+    oversized payload is buffered."""
+
+    def __init__(self, size: int, limit: int):
+        super().__init__(f"frame too large ({size} > {limit} bytes)")
+
+
+def read_frame(sock: socket.socket,
+               max_payload: int = 0) -> Tuple[int, bytes]:
+    """-> (opcode, payload); handles fragmentation by concatenation.
+    [max_payload] > 0 rejects oversized frames from the declared length
+    (never buffering them) with FrameTooLarge."""
     payload = b""
     opcode = None
     while True:
@@ -68,6 +97,8 @@ def read_frame(sock: socket.socket) -> Tuple[int, bytes]:
             ln = struct.unpack(">H", _recv_exact(sock, 2))[0]
         elif ln == 127:
             ln = struct.unpack(">Q", _recv_exact(sock, 8))[0]
+        if max_payload and len(payload) + ln > max_payload:
+            raise FrameTooLarge(len(payload) + ln, max_payload)
         mask = _recv_exact(sock, 4) if masked else None
         data = _recv_exact(sock, ln) if ln else b""
         if mask:
@@ -128,12 +159,18 @@ class WSServer:
     """WebSocket front-end over an RPCServer's method registry."""
 
     def __init__(self, rpc_server, refill_rate: float = 0,
-                 max_stored: float = 0):
+                 max_stored: float = 0, notify_queue_size: int = 0,
+                 max_payload: int = 0):
         self.rpc = rpc_server
         self.refill_rate = refill_rate
         self.max_stored = max_stored
+        # 0 = legacy unbuffered notification writes (no backpressure)
+        self.notify_queue_size = notify_queue_size
+        self.max_payload = max_payload
         self._sock: Optional[socket.socket] = None
         self._stop = threading.Event()
+        self._conns_lock = threading.Lock()
+        self._conns: set = set()  # guarded-by: _conns_lock
 
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> int:
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -150,6 +187,17 @@ class WSServer:
                 self._sock.close()
             except OSError:
                 pass
+        with self._conns_lock:
+            live = list(self._conns)
+        for conn in live:  # unblock readers parked in read_frame
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -157,6 +205,8 @@ class WSServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
@@ -189,17 +239,68 @@ class WSServer:
         subs: List[str] = []
         wlock = threading.Lock()
         bucket = _TokenBucket(self.refill_rate, self.max_stored)
+        closed = threading.Event()
+        notify_q: "Optional[queue.Queue]" = (
+            queue.Queue(maxsize=self.notify_queue_size)
+            if self.notify_queue_size > 0 else None)
 
         def send_json(obj) -> None:
             data = json.dumps(obj).encode()
             with wlock:
                 write_frame(conn, OP_TEXT, data)
 
+        def drop_conn() -> None:
+            # deterministic disconnect: close the socket so the reader
+            # unwinds and tears every subscription down
+            closed.set()
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+        def notify_writer() -> None:
+            while True:
+                obj = notify_q.get()
+                if obj is None:
+                    return
+                try:
+                    failpoint("ws/before_notify")
+                    send_json(obj)
+                except Exception:
+                    # a dead or erroring client ends *its* delivery only
+                    count_drop("rpc/ws/notify_errors")
+                    drop_conn()
+                    return
+
+        def send_notification(obj) -> None:
+            """Producer-side entry (runs on block-acceptance threads):
+            never blocks — a full queue means the client is too slow."""
+            if notify_q is None:
+                failpoint("ws/before_notify")
+                send_json(obj)
+                return
+            if closed.is_set():
+                default_registry.counter("rpc/ws/notify_drops").inc()
+                return
+            try:
+                notify_q.put_nowait(obj)
+            except queue.Full:
+                default_registry.counter("rpc/ws/notify_drops").inc()
+                default_registry.counter("rpc/ws/slow_disconnects").inc()
+                drop_conn()
+
+        if notify_q is not None:
+            threading.Thread(target=notify_writer, daemon=True,
+                             name="ws-notify").start()
         try:
             if not self._handshake(conn):
                 return
             while not self._stop.is_set():
-                op, payload = read_frame(conn)
+                op, payload = read_frame(conn, self.max_payload)
                 if op == OP_CLOSE:
                     with wlock:
                         write_frame(conn, OP_CLOSE, b"")
@@ -211,18 +312,31 @@ class WSServer:
                 if op != OP_TEXT:
                     continue
                 bucket.take()
-                self._handle_message(payload, send_json, subs)
+                self._handle_message(payload, send_json, send_notification,
+                                     subs)
+        except FrameTooLarge as e:
+            default_registry.counter("rpc/body_oversize").inc()
+            try:
+                send_json({"jsonrpc": "2.0", "id": None,
+                           "error": {"code": -32600, "message": str(e)}})
+            except OSError:
+                pass  # too-slow-to-even-read clients skip the courtesy
         except (ConnectionError, OSError):
             pass
         finally:
             for sid in subs:
                 self.rpc.unsubscribe(sid)
-            try:
-                conn.close()
-            except OSError:
-                pass
+            if notify_q is not None:
+                try:
+                    notify_q.put_nowait(None)  # release the writer
+                except queue.Full:
+                    pass  # writer is wedged; drop_conn unwedges its write
+            drop_conn()
+            with self._conns_lock:
+                self._conns.discard(conn)
 
-    def _handle_message(self, payload: bytes, send_json, subs: List[str]):
+    def _handle_message(self, payload: bytes, send_json, send_notification,
+                        subs: List[str]):
         try:
             req = json.loads(payload)
         except Exception:
@@ -230,7 +344,7 @@ class WSServer:
                        "error": {"code": -32700, "message": "parse error"}})
             return
         if isinstance(req, dict) and req.get("method") == "eth_subscribe":
-            self._do_subscribe(req, send_json, subs)
+            self._do_subscribe(req, send_json, send_notification, subs)
             return
         if isinstance(req, dict) and req.get("method") == "eth_unsubscribe":
             params = req.get("params") or []
@@ -242,7 +356,8 @@ class WSServer:
         resp = self.rpc.handle_raw(payload)
         send_json(json.loads(resp))
 
-    def _do_subscribe(self, req: dict, send_json, subs: List[str]) -> None:
+    def _do_subscribe(self, req: dict, send_json, send_notification,
+                      subs: List[str]) -> None:
         params = req.get("params") or []
         if not params:
             send_json({"jsonrpc": "2.0", "id": req.get("id"),
@@ -256,7 +371,7 @@ class WSServer:
         def notify(item):
             if holder[0] is None:
                 return
-            send_json({
+            send_notification({
                 "jsonrpc": "2.0",
                 "method": "eth_subscription",
                 "params": {"subscription": holder[0], "result": item},

@@ -10,9 +10,10 @@ from __future__ import annotations
 from typing import Optional
 
 from ..eth.api import EthAPI, PersonalAPI, hb, hx, parse_bytes
-from .config import DEFAULT_ETH_APIS
+from .config import DEFAULT_ETH_APIS, Config
 from ..eth.backend import EthBackend
 from ..eth.tracers import DebugAPI
+from ..rpc.admission import ServingPolicy
 from ..rpc.server import RPCError, RPCServer
 from .atomic_tx import Tx, decode_tx
 from .vm import ATOMIC_TX_INDEX_PREFIX
@@ -588,6 +589,15 @@ class DebugMetricsAPI:
         takeovers/quarantines, torn-tail repairs), newest last."""
         return self.vm.blockchain.flight_recorder.events(n=n, kind=kind)
 
+    def rpcStatus(self) -> dict:
+        """debug_rpcStatus: live serving-overload state — lane queue
+        depths/inflight, breaker state, drain status (ROBUSTNESS.md
+        "Serving under overload")."""
+        server = getattr(self.vm, "rpc_server", None)
+        if server is None:
+            return {"pooled": False}
+        return server.serving_status()
+
 
 def create_handlers(vm, allow_unfinalized_queries: bool = False) -> RPCServer:
     """CreateHandlers (vm.go:1138): the full RPC surface on one server,
@@ -601,9 +611,14 @@ def create_handlers(vm, allow_unfinalized_queries: bool = False) -> RPCServer:
     backend = EthBackend(vm.blockchain, vm.txpool, allow_unfinalized,
                          keystore=getattr(vm, "keystore", None),
                          external_signer=getattr(vm, "external_signer",
-                                                 None))
+                                                 None),
+                         api_max_blocks=(cfg.api_max_blocks_per_request
+                                         if cfg is not None else 0))
     vm.eth_backend = backend
-    server = RPCServer()
+    server = RPCServer(
+        policy=ServingPolicy.from_config(cfg if cfg is not None
+                                         else Config()))
+    vm.rpc_server = server
     eth = EthAPI(backend)
     if apis & {"eth", "internal-eth", "internal-blockchain",
                "internal-transaction"}:
@@ -684,6 +699,9 @@ def serve_ws(vm, host: str = "127.0.0.1", port: int = 0,
 
     server = rpc_server if rpc_server is not None else create_handlers(vm)
     cfg = vm.full_config
+    body_limit = server.policy.body_limit if server.policy is not None else 0
     ws = WSServer(server, refill_rate=cfg.ws_cpu_refill_rate,
-                  max_stored=cfg.ws_cpu_max_stored)
+                  max_stored=cfg.ws_cpu_max_stored,
+                  notify_queue_size=cfg.ws_notify_queue_size,
+                  max_payload=body_limit)
     return ws, ws.serve(host, port)

@@ -32,6 +32,37 @@ class Config:
     allow_unfinalized_queries: bool = False
     allow_unprotected_txs: bool = False
 
+    # --- RPC overload protection (ROBUSTNESS.md: serving under overload) --
+    # cheap-lane worker threads; 0 disables pooling entirely (inline
+    # dispatch on the transport thread — the seed behavior)
+    rpc_max_workers: int = 8
+    # cheap-lane admission queue depth; a full queue sheds -32005/429
+    rpc_queue_size: int = 64
+    # expensive-lane (eth_call/eth_getLogs/debug_trace*) workers + queue:
+    # a tracing storm saturates this lane and never touches cheap reads
+    rpc_expensive_workers: int = 4
+    rpc_expensive_queue_size: int = 16
+    # expensive-method deadline budget (s); 0 falls back to
+    # api-max-duration (which covers cheap methods). 0/0 = no deadlines
+    rpc_expensive_duration: float = 0.0
+    # batch + body caps (proper error object instead of an OOM)
+    rpc_batch_limit: int = 100
+    rpc_body_limit: int = 5 * 1024 * 1024
+    # expensive-method circuit breaker: threshold consecutive timeouts
+    # open it; while open every probe-every-th arrival probes; close-after
+    # consecutive probe passes re-close it. threshold 0 disables
+    rpc_breaker_threshold: int = 5
+    rpc_breaker_probe_every: int = 8
+    rpc_breaker_close_after: int = 3
+    # stop() drains in-flight dispatch up to this many seconds before
+    # abandoning (reported in the drain result)
+    rpc_drain_timeout: float = 5.0
+    # concurrent HTTP connection cap (excess answered 429 inline); 0 off
+    rpc_max_connections: int = 128
+    # per-websocket-client bounded notification queue; overflow
+    # disconnects the slow client. 0 = legacy unbuffered direct writes
+    ws_notify_queue_size: int = 256
+
     # --- caches ----------------------------------------------------------
     trie_clean_cache: int = 512        # MB
     trie_dirty_cache: int = 256        # MB
@@ -221,6 +252,33 @@ class Config:
             raise ValueError(
                 f"metrics-http-port must be in [0, 65535] "
                 f"(got {self.metrics_http_port})")
+        if self.api_max_duration < 0:
+            raise ValueError(
+                f"api-max-duration must be >= 0 (got {self.api_max_duration})")
+        if self.api_max_blocks_per_request < 0:
+            raise ValueError(
+                f"api-max-blocks-per-request must be >= 0 "
+                f"(got {self.api_max_blocks_per_request})")
+        for knob in ("rpc_max_workers", "rpc_expensive_duration",
+                     "rpc_batch_limit", "rpc_body_limit",
+                     "rpc_breaker_threshold", "rpc_drain_timeout",
+                     "rpc_max_connections", "ws_notify_queue_size"):
+            if getattr(self, knob) < 0:
+                raise ValueError(
+                    f"{knob.replace('_', '-')} must be >= 0 "
+                    f"(got {getattr(self, knob)})")
+        if self.rpc_max_workers > 0:
+            for knob in ("rpc_queue_size", "rpc_expensive_workers",
+                         "rpc_expensive_queue_size"):
+                if getattr(self, knob) < 1:
+                    raise ValueError(
+                        f"{knob.replace('_', '-')} must be >= 1 when "
+                        f"rpc-max-workers > 0 (got {getattr(self, knob)})")
+        for knob in ("rpc_breaker_probe_every", "rpc_breaker_close_after"):
+            if getattr(self, knob) < 1:
+                raise ValueError(
+                    f"{knob.replace('_', '-')} must be >= 1 "
+                    f"(got {getattr(self, knob)})")
         if self.resident_account_trie is True and not self.pruning_enabled:
             raise ValueError(
                 "resident-account-trie requires pruning: interval "

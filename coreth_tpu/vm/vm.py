@@ -500,6 +500,12 @@ class VM:
                 self.continuous_profiler.stop()
             if self.metrics_http is not None:
                 self.metrics_http.stop()
+            # graceful RPC drain first: in-flight reads finish (bounded
+            # by rpc-drain-timeout) before the chain under them stops
+            rpc_server = getattr(self, "rpc_server", None)
+            if rpc_server is not None:
+                rpc_server.stop()
+                self.rpc_server = None
             self.blockchain.stop()
 
     # --- VMBlock support ---------------------------------------------------

@@ -1,0 +1,719 @@
+"""Overload-robust RPC serving (ISSUE 7): bounded admission + shed,
+cooperative deadlines, expensive-method circuit breaker, websocket
+backpressure, batch/body caps, and graceful drain.
+
+Determinism: arrivals are orchestrated with failpoints (`hang` parks a
+worker exactly like a wedged handler; `hang:<ms>` is a slow handler) and
+all polling goes through fault.Backoff — no naked sleeps, no reliance on
+TCP buffer sizes or scheduler luck.
+"""
+
+import json
+import socket
+import threading
+import time
+import types
+
+import pytest
+
+from coreth_tpu import fault
+from coreth_tpu.metrics import default_registry
+from coreth_tpu.rpc.admission import (ABANDONED, LIMIT_EXCEEDED,
+                                      TIMEOUT_ERROR, CircuitBreaker,
+                                      ServingPolicy, is_expensive)
+from coreth_tpu.rpc.server import RPCServer
+from coreth_tpu.rpc.websocket import (OP_TEXT, FrameTooLarge, WSClient,
+                                      WSServer, read_frame, write_frame)
+from coreth_tpu.utils import deadline as dl
+from coreth_tpu.vm.config import Config, parse_config
+
+
+def _req(method, params=None, rid=1):
+    return json.dumps({"jsonrpc": "2.0", "id": rid, "method": method,
+                       "params": params or []}).encode()
+
+
+def _rpc(server, method, params=None, rid=1, meta=None):
+    return json.loads(server.handle_raw(_req(method, params, rid), meta))
+
+
+def _count(name):
+    return default_registry.counter(name).count()
+
+
+def _fired(name):
+    for a in fault.list_armed():
+        if a["name"] == name:
+            return a["fired"]
+    return 0
+
+
+def _poll(pred, what=""):
+    b = fault.Backoff(base=0.005, factor=1.3, cap=0.1, jitter=0.0)
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        b.sleep()
+    raise AssertionError(f"timed out waiting for {what or pred}")
+
+
+def _server(**policy_kw):
+    srv = RPCServer(policy=ServingPolicy(**policy_kw))
+    srv.register("eth", "ping", lambda: "pong")
+    srv.register("eth", "getLogs", lambda *a: [])  # expensive lane
+    return srv
+
+
+# --- deadline primitive ----------------------------------------------------
+
+
+class TestDeadline:
+    def test_check_is_free_when_unarmed(self):
+        dl.check()  # no deadline installed: no-op
+
+    def test_scope_installs_and_restores(self):
+        assert dl.current() is None
+        outer = dl.Deadline(10.0)
+        with dl.scope(outer):
+            assert dl.current() is outer
+            inner = dl.Deadline(5.0)
+            with dl.scope(inner):
+                assert dl.current() is inner
+            assert dl.current() is outer
+        assert dl.current() is None
+
+    def test_none_scope_is_noop(self):
+        with dl.scope(None):
+            assert dl.current() is None
+
+    def test_expired_deadline_raises(self):
+        with dl.scope(dl.Deadline(0.0)):
+            with pytest.raises(dl.DeadlineExceeded, match="0s budget"):
+                dl.check()
+
+
+# --- lane classification ---------------------------------------------------
+
+
+def test_expensive_classification():
+    for m in ("eth_call", "eth_getLogs", "eth_estimateGas",
+              "debug_traceTransaction", "debug_traceBlockByNumber",
+              "eth_getProof", "eth_feeHistory"):
+        assert is_expensive(m), m
+    for m in ("eth_blockNumber", "eth_getBalance", "net_version",
+              "web3_clientVersion", "debug_metrics", "txpool_status"):
+        assert not is_expensive(m), m
+
+
+def test_deadline_budget_skips_operator_namespaces():
+    p = ServingPolicy(max_workers=0, cheap_budget=1.0)
+    assert p.budget_for("eth_getBalance") == 1.0
+    # consensus-mutating surfaces must never be aborted mid-mutation
+    assert p.budget_for("admin_importChain") == 0.0
+    assert p.budget_for("avax_issueTx") == 0.0
+
+
+# --- shed at capacity ------------------------------------------------------
+
+
+class TestShedAtCapacity:
+    def test_full_queue_sheds_minus_32005_fast(self):
+        srv = _server(max_workers=1, queue_size=1, expensive_workers=1,
+                      expensive_queue_size=1)
+        shed_before = _count("rpc/shed/queue_full")
+        fault.set_failpoint("rpc/before_dispatch", "hang")
+        results = {}
+
+        def call(key):
+            results[key] = _rpc(srv, "eth_ping", rid=key)
+
+        t1 = threading.Thread(target=call, args=(1,), daemon=True)
+        t1.start()
+        _poll(lambda: _fired("rpc/before_dispatch") >= 1, "worker parked")
+        t2 = threading.Thread(target=call, args=(2,), daemon=True)
+        t2.start()
+        _poll(lambda: srv.policy.cheap_pool.busy() >= 2, "request queued")
+
+        t0 = time.monotonic()
+        meta = {}
+        shed = _rpc(srv, "eth_ping", rid=3, meta=meta)
+        assert time.monotonic() - t0 < 1.0, "shed must answer fast"
+        assert shed["error"]["code"] == LIMIT_EXCEEDED
+        assert "capacity" in shed["error"]["message"]
+        assert meta["status"] == 429 and meta["retry_after"] == 1
+        assert _count("rpc/shed/queue_full") == shed_before + 1
+
+        fault.set_failpoint("rpc/before_dispatch", None)  # unpark
+        t1.join(5)
+        t2.join(5)
+        assert results[1]["result"] == "pong"
+        assert results[2]["result"] == "pong"
+
+    def test_expensive_saturation_leaves_cheap_lane_alone(self):
+        srv = _server(max_workers=2, queue_size=4, expensive_workers=1,
+                      expensive_queue_size=1)
+        fault.set_failpoint("rpc/before_dispatch_expensive", "hang")
+        t = threading.Thread(
+            target=lambda: srv.handle_raw(_req("eth_getLogs", [{}])),
+            daemon=True)
+        t.start()
+        _poll(lambda: _fired("rpc/before_dispatch_expensive") >= 1,
+              "expensive worker parked")
+        # cheap lane unaffected while the expensive lane is wedged
+        t0 = time.monotonic()
+        assert _rpc(srv, "eth_ping")["result"] == "pong"
+        assert time.monotonic() - t0 < 1.0
+        fault.set_failpoint("rpc/before_dispatch_expensive", None)
+        t.join(5)
+
+
+# --- cooperative deadlines -------------------------------------------------
+
+
+class TestDeadlineDispatch:
+    def test_slow_handler_times_out_and_frees_worker(self):
+        srv = RPCServer(policy=ServingPolicy(
+            max_workers=1, queue_size=4, expensive_workers=1,
+            expensive_queue_size=1, cheap_budget=0.02))
+
+        def slow_scan():
+            fault.Backoff(base=0.06, factor=1.0, cap=0.06, jitter=0.0).sleep()
+            dl.check()  # the cooperative checkpoint mid-"scan"
+            return "never"
+
+        srv.register("eth", "slowScan", slow_scan)
+        srv.register("eth", "ping", lambda: "pong")
+        timeouts_before = _count("rpc/timeout")
+        resp = _rpc(srv, "eth_slowScan")
+        assert resp["error"]["code"] == TIMEOUT_ERROR
+        assert "budget" in resp["error"]["message"]
+        assert _count("rpc/timeout") == timeouts_before + 1
+        # the worker was released, not wedged: next request serves fine
+        assert _rpc(srv, "eth_ping")["result"] == "pong"
+
+    def test_queue_wait_counts_against_the_budget(self):
+        # hang:80 before dispatch burns the 20ms budget before the
+        # handler body would even run: the dispatch-entry checkpoint
+        # sheds it without executing the handler
+        srv = _server(max_workers=2, queue_size=4, expensive_workers=1,
+                      expensive_queue_size=2, expensive_budget=0.02)
+        fault.set_failpoint("rpc/before_dispatch_expensive", "hang:80")
+        resp = _rpc(srv, "eth_getLogs", [{}])
+        assert resp["error"]["code"] == TIMEOUT_ERROR
+
+
+# --- circuit breaker -------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_unit_open_probe_close_cycle(self):
+        br = CircuitBreaker(threshold=3, probe_every=2, close_after=2)
+        for _ in range(3):
+            assert br.admit() == "admit"
+            br.record(timed_out=True, probe=False)
+        assert br.is_open()
+        # while open: every probe_every-th arrival probes, rest shed
+        assert br.admit() == "shed"
+        assert br.admit() == "probe"
+        br.record(timed_out=False, probe=True)
+        assert br.is_open()  # one pass < close_after
+        assert br.admit() == "shed"
+        assert br.admit() == "probe"
+        br.record(timed_out=False, probe=True)
+        assert not br.is_open()
+        assert br.admit() == "admit"
+
+    def test_probe_timeout_keeps_it_open(self):
+        br = CircuitBreaker(threshold=1, probe_every=1, close_after=2)
+        br.record(timed_out=True, probe=False)
+        assert br.is_open()
+        assert br.admit() == "probe"
+        br.record(timed_out=False, probe=True)
+        br.record(timed_out=True, probe=True)  # pass streak resets
+        assert br.admit() == "probe"
+        br.record(timed_out=False, probe=True)
+        assert br.is_open()  # streak is 1 again, needs 2
+
+    def test_threshold_zero_disables(self):
+        br = CircuitBreaker(threshold=0, probe_every=1, close_after=1)
+        for _ in range(10):
+            br.record(timed_out=True, probe=False)
+            assert br.admit() == "admit"
+
+    def test_in_server_open_shed_and_reclose(self):
+        srv = _server(max_workers=2, queue_size=4, expensive_workers=1,
+                      expensive_queue_size=4, expensive_budget=0.02,
+                      breaker_threshold=2, breaker_probe_every=2,
+                      breaker_close_after=1)
+        opens_before = _count("rpc/breaker/opens")
+        closes_before = _count("rpc/breaker/closes")
+        fault.set_failpoint("rpc/before_dispatch_expensive", "hang:60")
+        for rid in (1, 2):  # two consecutive timeouts open it
+            resp = _rpc(srv, "eth_getLogs", [{}], rid=rid)
+            assert resp["error"]["code"] == TIMEOUT_ERROR
+        assert srv.policy.breaker.is_open()
+        assert _count("rpc/breaker/opens") == opens_before + 1
+        assert default_registry.gauge("rpc/breaker/state").value() == 1
+
+        resp = _rpc(srv, "eth_getLogs", [{}], rid=3)  # arrival 1: shed
+        assert resp["error"]["code"] == LIMIT_EXCEEDED
+        assert "breaker" in resp["error"]["message"]
+
+        fault.set_failpoint("rpc/before_dispatch_expensive", None)
+        resp = _rpc(srv, "eth_getLogs", [{}], rid=4)  # arrival 2: probe
+        assert resp.get("result") == []
+        assert not srv.policy.breaker.is_open()
+        assert _count("rpc/breaker/closes") == closes_before + 1
+        assert default_registry.gauge("rpc/breaker/state").value() == 0
+
+
+# --- eth_getLogs range guard ----------------------------------------------
+
+
+class _StubChain:
+    bloom_indexer = None
+
+    def subscribe_chain_accepted_event(self, cb):
+        pass
+
+    def get_block(self, h):
+        return None
+
+    def get_block_by_number(self, n):
+        return None
+
+    def get_receipts(self, h):
+        return []
+
+
+class _StubBackend:
+    def __init__(self, head=99, api_max_blocks=0):
+        self.chain = _StubChain()
+        self.api_max_blocks = api_max_blocks
+        self._head = head
+
+    def last_accepted_block(self):
+        return types.SimpleNamespace(number=self._head)
+
+
+class TestGetLogsRangeGuard:
+    def test_oversized_range_sheds(self):
+        from coreth_tpu.eth.filters import FilterSystem
+        from coreth_tpu.rpc.server import RPCError
+
+        fs = FilterSystem(_StubBackend(api_max_blocks=4))
+        with pytest.raises(RPCError) as ei:
+            fs.get_logs({"fromBlock": "0x0", "toBlock": "0x9"})
+        assert ei.value.code == LIMIT_EXCEEDED
+        assert "range too large" in str(ei.value)
+
+    def test_range_within_cap_scans(self):
+        from coreth_tpu.eth.filters import FilterSystem
+
+        fs = FilterSystem(_StubBackend(api_max_blocks=4))
+        assert fs.get_logs({"fromBlock": "0x0", "toBlock": "0x3"}) == []
+
+    def test_scan_checks_deadline(self):
+        from coreth_tpu.eth.filters import FilterSystem
+
+        fs = FilterSystem(_StubBackend(api_max_blocks=0))
+        with dl.scope(dl.Deadline(0.0)):
+            with pytest.raises(dl.DeadlineExceeded):
+                fs.get_logs({"fromBlock": "0x0", "toBlock": "0x40"})
+
+    def test_scan_blocks_periodic_check(self):
+        from coreth_tpu.eth.filters import FilterSystem
+
+        fs = FilterSystem(_StubBackend())
+        crit = {"addresses": [], "topics": [], "block_hash": None,
+                "from": None, "to": None}
+        with dl.scope(dl.Deadline(0.0)):
+            with pytest.raises(dl.DeadlineExceeded):
+                fs._scan_blocks([None] * 40, crit)
+
+
+# --- batch and body caps ---------------------------------------------------
+
+
+class TestBatchBodyCaps:
+    def test_batch_over_limit_rejected_with_error_object(self):
+        srv = _server(max_workers=0, batch_limit=3)
+        batch = [json.loads(_req("eth_ping", rid=i)) for i in range(4)]
+        resp = json.loads(srv.handle_raw(json.dumps(batch).encode()))
+        assert isinstance(resp, dict)  # one error object, not a list
+        assert resp["error"]["code"] == -32600
+        assert "batch too large" in resp["error"]["message"]
+
+    def test_batch_at_limit_ok(self):
+        srv = _server(max_workers=0, batch_limit=3)
+        batch = [json.loads(_req("eth_ping", rid=i)) for i in range(3)]
+        resp = json.loads(srv.handle_raw(json.dumps(batch).encode()))
+        assert [r["result"] for r in resp] == ["pong"] * 3
+
+    def test_body_over_limit_rejected(self):
+        srv = _server(max_workers=0, body_limit=64)
+        meta = {}
+        resp = json.loads(srv.handle_raw(
+            _req("eth_ping", ["x" * 200]), meta))
+        assert resp["error"]["code"] == -32600
+        assert "body too large" in resp["error"]["message"]
+        assert meta["status"] == 413
+
+    def test_ws_frame_cap(self):
+        a, b = socket.socketpair()
+        try:
+            write_frame(a, OP_TEXT, b"x" * 100, mask=True)
+            with pytest.raises(FrameTooLarge):
+                read_frame(b, max_payload=10)
+        finally:
+            a.close()
+            b.close()
+
+    def test_ipc_body_cap_and_roundtrip(self, tmp_path):
+        srv = _server(max_workers=1, queue_size=4, expensive_workers=1,
+                      expensive_queue_size=1, body_limit=128)
+        path = str(tmp_path / "rpc.sock")
+        srv.serve_ipc(path)
+        try:
+            with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as c:
+                c.connect(path)
+                c.sendall(_req("eth_ping") + b"\n")
+                line = b""
+                while not line.endswith(b"\n"):
+                    chunk = c.recv(4096)
+                    if not chunk:
+                        break
+                    line += chunk
+                assert json.loads(line)["result"] == "pong"
+            with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as c:
+                c.connect(path)
+                c.sendall(_req("eth_ping", ["y" * 500]) + b"\n")
+                line = b""
+                while not line.endswith(b"\n"):
+                    chunk = c.recv(4096)
+                    if not chunk:
+                        break
+                    line += chunk
+                assert json.loads(line)["error"]["code"] == -32600
+        finally:
+            report = srv.stop()  # also closes the IPC endpoint
+        assert report["drained"] is True
+
+
+# --- HTTP transport status codes ------------------------------------------
+
+
+class TestHTTPTransport:
+    def test_200_413_and_breaker_429(self):
+        import urllib.error
+        import urllib.request
+
+        srv = _server(max_workers=2, queue_size=4, expensive_workers=1,
+                      expensive_queue_size=2, body_limit=4096,
+                      breaker_threshold=1, breaker_probe_every=2,
+                      breaker_close_after=1)
+        port = srv.serve_http()
+        url = f"http://127.0.0.1:{port}"
+
+        def post(body):
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            return urllib.request.urlopen(req, timeout=10)
+
+        try:
+            with post(_req("eth_ping")) as r:
+                assert r.status == 200
+                assert json.loads(r.read())["result"] == "pong"
+
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post(_req("eth_ping", ["z" * 8192]))
+            assert ei.value.code == 413
+
+            srv.policy.breaker.record(timed_out=True, probe=False)
+            assert srv.policy.breaker.is_open()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post(_req("eth_getLogs", [{}]))
+            assert ei.value.code == 429
+            assert ei.value.headers["Retry-After"] == "1"
+            body = json.loads(ei.value.read())
+            assert body["error"]["code"] == LIMIT_EXCEEDED
+        finally:
+            srv.stop()
+
+
+# --- graceful drain --------------------------------------------------------
+
+
+class TestGracefulDrain:
+    def test_drain_abandons_wedged_work_and_answers_waiters(self):
+        srv = _server(max_workers=1, queue_size=2, expensive_workers=1,
+                      expensive_queue_size=1)
+        fault.set_failpoint("rpc/before_dispatch", "hang")
+        results = {}
+
+        def call(rid):
+            results[rid] = _rpc(srv, "eth_ping", rid=rid)
+
+        threads = [threading.Thread(target=call, args=(i,), daemon=True)
+                   for i in range(3)]
+        threads[0].start()
+        _poll(lambda: _fired("rpc/before_dispatch") >= 1, "worker parked")
+        for t in threads[1:]:
+            t.start()
+        _poll(lambda: srv.policy.cheap_pool.busy() >= 3, "queue loaded")
+
+        abandoned_before = _count("rpc/abandoned")
+        t0 = time.monotonic()
+        report = srv.stop(drain_timeout=0.2)
+        assert time.monotonic() - t0 < 2.0, "drain must respect its bound"
+        assert report["drained"] is False
+        assert report["abandoned"] == 3
+        assert report["abandoned_methods"].count("eth_ping") == 3
+        assert _count("rpc/abandoned") == abandoned_before + 3
+        for t in threads:
+            t.join(5)
+        for rid in range(3):
+            err = results[rid]["error"]
+            assert err["code"] == TIMEOUT_ERROR
+            assert "shut down" in err["message"]
+        # post-drain submissions shed as draining
+        resp = _rpc(srv, "eth_ping", rid=9)
+        assert resp["error"]["code"] == TIMEOUT_ERROR
+        assert "draining" in resp["error"]["message"]
+
+    def test_drain_waits_for_inflight_to_finish(self):
+        srv = _server(max_workers=1, queue_size=2, expensive_workers=1,
+                      expensive_queue_size=1)
+        fault.set_failpoint("rpc/before_dispatch", "hang:50")
+        results = {}
+        t = threading.Thread(
+            target=lambda: results.update(ok=_rpc(srv, "eth_ping")),
+            daemon=True)
+        t.start()
+        _poll(lambda: srv.policy.cheap_pool.busy() >= 1, "request admitted")
+        report = srv.stop(drain_timeout=5.0)
+        assert report["drained"] is True
+        assert report["abandoned"] == 0
+        t.join(5)
+        assert results["ok"]["result"] == "pong"
+
+    def test_stop_is_idempotent(self):
+        srv = _server(max_workers=1, queue_size=1, expensive_workers=1,
+                      expensive_queue_size=1)
+        assert srv.stop()["drained"] is True
+        assert srv.stop()["drained"] is True
+
+
+# --- websocket backpressure ------------------------------------------------
+
+
+class TestWSBackpressure:
+    def _ws_stack(self, notify_queue_size):
+        srv = RPCServer()
+        feeds = []
+
+        def factory(notify, *params):
+            feeds.append(notify)
+            return None
+
+        srv.register_subscription("eth", "newHeads", factory)
+        ws = WSServer(srv, notify_queue_size=notify_queue_size)
+        port = ws.serve()
+        return srv, ws, port, feeds
+
+    def test_slow_client_disconnected_deterministically(self):
+        srv, ws, port, feeds = self._ws_stack(notify_queue_size=2)
+        try:
+            c1 = WSClient("127.0.0.1", port)
+            c1.request("eth_subscribe", ["newHeads"])
+            assert len(feeds) == 1
+            drops_before = _count("rpc/ws/notify_drops")
+            disc_before = _count("rpc/ws/slow_disconnects")
+
+            fault.set_failpoint("ws/before_notify", "hang")
+            feeds[0]({"n": 0})  # writer dequeues this one and parks
+            _poll(lambda: _fired("ws/before_notify") >= 1, "writer parked")
+            t0 = time.monotonic()
+            for i in range(1, 5):  # fills the queue (2), then overflows
+                feeds[0]({"n": i})
+            assert time.monotonic() - t0 < 1.0, "producer must never block"
+            assert _count("rpc/ws/notify_drops") > drops_before
+            assert _count("rpc/ws/slow_disconnects") == disc_before + 1
+
+            with pytest.raises((ConnectionError, OSError)):
+                while True:  # the slow client is torn down, not wedged
+                    c1.next_notification(timeout=5.0)
+
+            # a healthy second client is unaffected by the slow one
+            c2 = WSClient("127.0.0.1", port)
+            c2.request("eth_subscribe", ["newHeads"])
+            assert len(feeds) == 2
+            fault.set_failpoint("ws/before_notify", None)
+            feeds[1]({"fresh": True})
+            note = c2.next_notification(timeout=10.0)
+            assert note["params"]["result"] == {"fresh": True}
+            c2.close()
+        finally:
+            ws.stop()
+
+    def test_queue_size_zero_keeps_legacy_direct_writes(self):
+        srv, ws, port, feeds = self._ws_stack(notify_queue_size=0)
+        try:
+            c = WSClient("127.0.0.1", port)
+            c.request("eth_subscribe", ["newHeads"])
+            feeds[0]({"direct": 1})
+            assert c.next_notification(
+                timeout=10.0)["params"]["result"] == {"direct": 1}
+            c.close()
+        finally:
+            ws.stop()
+
+
+# --- knob plumbing ---------------------------------------------------------
+
+
+class TestKnobs:
+    def test_defaults_validate(self):
+        parse_config(b"{}").validate()
+
+    @pytest.mark.parametrize("blob,frag", [
+        (b'{"rpc-max-workers": -1}', "rpc-max-workers"),
+        (b'{"rpc-queue-size": 0}', "rpc-queue-size"),
+        (b'{"rpc-expensive-workers": 0}', "rpc-expensive-workers"),
+        (b'{"rpc-breaker-probe-every": 0}', "rpc-breaker-probe-every"),
+        (b'{"rpc-breaker-close-after": 0}', "rpc-breaker-close-after"),
+        (b'{"rpc-drain-timeout": -1}', "rpc-drain-timeout"),
+        (b'{"ws-notify-queue-size": -5}', "ws-notify-queue-size"),
+        (b'{"api-max-duration": -0.5}', "api-max-duration"),
+        (b'{"api-max-blocks-per-request": -1}', "api-max-blocks"),
+    ])
+    def test_bad_knobs_rejected(self, blob, frag):
+        with pytest.raises(ValueError, match=frag):
+            parse_config(blob)
+
+    def test_workers_zero_skips_lane_minimums(self):
+        # pooling off: lane sizing knobs are irrelevant and unchecked
+        cfg = parse_config(b'{"rpc-max-workers": 0, "rpc-queue-size": 0}')
+        assert ServingPolicy.from_config(cfg).cheap_pool is None
+
+    def test_from_config_mapping(self):
+        cfg = parse_config(json.dumps({
+            "rpc-max-workers": 3, "rpc-queue-size": 7,
+            "rpc-expensive-workers": 2, "rpc-expensive-queue-size": 5,
+            "api-max-duration": 1.5, "rpc-expensive-duration": 2.5,
+            "rpc-batch-limit": 11, "rpc-body-limit": 1024,
+            "rpc-breaker-threshold": 4, "rpc-drain-timeout": 0.5,
+            "ws-notify-queue-size": 9,
+        }).encode())
+        p = ServingPolicy.from_config(cfg)
+        assert p.cheap_pool.workers == 3
+        assert p.cheap_pool._q.maxsize == 7
+        assert p.expensive_pool.workers == 2
+        assert p.expensive_pool._q.maxsize == 5
+        assert p.budget_for("eth_blockNumber") == 1.5
+        assert p.budget_for("eth_getLogs") == 2.5
+        assert p.batch_limit == 11 and p.body_limit == 1024
+        assert p.breaker.threshold == 4
+        assert p.drain_timeout == 0.5
+        assert p.ws_notify_queue_size == 9
+
+    def test_serving_status_surface(self):
+        srv = _server(max_workers=2, queue_size=4, expensive_workers=1,
+                      expensive_queue_size=2)
+        st = srv.serving_status()
+        assert st["pooled"] is True
+        assert st["breaker"]["state"] == "closed"
+        assert st["cheap"]["workers"] == 2
+        assert st["expensive"]["queue_capacity"] == 2
+        assert RPCServer().serving_status() == {"pooled": False}
+
+
+# --- the acceptance drill --------------------------------------------------
+
+
+class TestOverloadDrill:
+    def test_open_loop_storm_at_4x_saturation(self):
+        """~4x saturation on the expensive lane: sheds answer fast with
+        -32005, cheap latency stays bounded, the breaker opens and
+        re-closes, and stop() drains cleanly mid-storm."""
+        import random
+
+        rng = random.Random(0x7007)
+        srv = _server(max_workers=2, queue_size=8, expensive_workers=1,
+                      expensive_queue_size=2, expensive_budget=0.03,
+                      breaker_threshold=2, breaker_probe_every=2,
+                      breaker_close_after=1, drain_timeout=0.3)
+        opens_before = _count("rpc/breaker/opens")
+        closes_before = _count("rpc/breaker/closes")
+        sheds_before = _count("rpc/shed")
+        timeouts_before = _count("rpc/timeout")
+
+        # every expensive dispatch takes 60ms against a 30ms budget
+        fault.set_failpoint("rpc/before_dispatch_expensive", "hang:60")
+
+        # open-loop storm: 12 expensive (capacity: 1 running + 2 queued)
+        # + 8 cheap arrivals, interleaved in a seeded order
+        jobs = [("eth_getLogs", [{}])] * 12 + [("eth_ping", [])] * 8
+        rng.shuffle(jobs)
+        results = [None] * len(jobs)
+        lat = [0.0] * len(jobs)
+
+        def run(i, method, params):
+            t0 = time.monotonic()
+            results[i] = _rpc(srv, method, params, rid=i)
+            lat[i] = time.monotonic() - t0
+
+        threads = [threading.Thread(target=run, args=(i, m, p), daemon=True)
+                   for i, (m, p) in enumerate(jobs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+            assert not t.is_alive(), "storm request wedged"
+
+        for i, (method, _p) in enumerate(jobs):
+            resp = results[i]
+            if method == "eth_ping":
+                assert resp["result"] == "pong"
+                assert lat[i] < 2.0, "cheap latency must stay bounded"
+            else:
+                if "error" in resp:
+                    assert resp["error"]["code"] in (LIMIT_EXCEEDED,
+                                                     TIMEOUT_ERROR)
+                    if resp["error"]["code"] == LIMIT_EXCEEDED:
+                        assert lat[i] < 1.0, "sheds must answer fast"
+                else:
+                    assert resp["result"] == []
+        assert _count("rpc/shed") > sheds_before, "storm must shed"
+        assert _count("rpc/timeout") >= timeouts_before + 2
+        assert _count("rpc/breaker/opens") == opens_before + 1
+
+        # recovery: disarm the slowness, probe arrivals re-close it
+        fault.set_failpoint("rpc/before_dispatch_expensive", None)
+        for rid in range(100, 104):
+            resp = _rpc(srv, "eth_getLogs", [{}], rid=rid)
+            if "result" in resp:
+                break
+        assert not srv.policy.breaker.is_open()
+        assert _count("rpc/breaker/closes") == closes_before + 1
+
+        # second storm, then drain mid-storm: stop() returns within its
+        # bound and every outstanding request gets an answer
+        fault.set_failpoint("rpc/before_dispatch_expensive", "hang")
+        storm2 = [threading.Thread(
+            target=lambda i=i: _rpc(srv, "eth_getLogs", [{}], rid=200 + i),
+            daemon=True) for i in range(3)]
+        for t in storm2:
+            t.start()
+        _poll(lambda: _fired("rpc/before_dispatch_expensive") >= 1,
+              "second storm landed")
+        t0 = time.monotonic()
+        report = srv.stop()  # default: policy drain_timeout (0.3s)
+        assert time.monotonic() - t0 < 2.0
+        assert report["abandoned"] >= 1
+        fault.set_failpoint("rpc/before_dispatch_expensive", None)
+        for t in storm2:
+            t.join(5)
+            assert not t.is_alive(), "drain must answer every waiter"

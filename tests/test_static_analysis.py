@@ -373,6 +373,67 @@ def test_sa006_sleep_allowed_inside_fault_package():
     assert _check_many([(src, "coreth_tpu/fault/__init__.py")]) == []
 
 
+# ---------------------------------------------------------------- SA007
+
+_SA007_BAD = """
+import queue
+from queue import Queue as Q, SimpleQueue
+from concurrent.futures import ThreadPoolExecutor
+
+
+def build():
+    a = queue.Queue()                     # no maxsize
+    b = Q(maxsize=0)                      # 0 = unbounded for queue.Queue
+    c = SimpleQueue()                     # always unbounded
+    d = ThreadPoolExecutor()              # host-sized, not budget-sized
+    return a, b, c, d
+"""
+
+
+@pytest.mark.parametrize("relpath", [
+    "coreth_tpu/rpc/fixture.py",
+    "coreth_tpu/vm/api.py",
+    "coreth_tpu/eth/filters.py",
+    "coreth_tpu/metrics/http.py",
+])
+def test_sa007_fires_in_serving_paths(relpath):
+    out = [f for f in findings(_SA007_BAD, relpath) if f.rule == "SA007"]
+    assert len(out) == 4
+    assert all(f.qualname == "build" for f in out)
+
+
+def test_sa007_quiet_outside_serving_paths():
+    # the same constructions are fine in batch/client-side modules
+    out = findings(_SA007_BAD, "coreth_tpu/ethclient/fixture.py")
+    assert [f for f in out if f.rule == "SA007"] == []
+
+
+def test_sa007_quiet_on_bounded_construction():
+    src = """
+    import queue
+    from concurrent.futures import ThreadPoolExecutor
+
+    def build(n):
+        a = queue.Queue(maxsize=64)
+        b = queue.Queue(n)          # positional bound: not statically 0
+        c = ThreadPoolExecutor(max_workers=4)
+        return a, b, c
+    """
+    out = findings(src, "coreth_tpu/rpc/fixture.py")
+    assert [f for f in out if f.rule == "SA007"] == []
+
+
+def test_sa007_fires_on_executor_with_explicit_none():
+    src = """
+    from concurrent.futures import ThreadPoolExecutor
+
+    def build():
+        return ThreadPoolExecutor(max_workers=None)
+    """
+    out = findings(src, "coreth_tpu/rpc/fixture.py")
+    assert [f.rule for f in out] == ["SA007"]
+
+
 # ------------------------------------------------------------ repo gate
 
 def test_repo_is_clean_modulo_baseline():
