@@ -14,6 +14,9 @@ Configs (BASELINE.md "measurable baselines"):
      resident commit)
   10 chain-level insert with the RESIDENT account trie vs default —
      the end-to-end number for the resident chain integration
+  11-12 (dispatch-fusion A/B; interpreter dispatch micro-bench)
+  13 chain-level insert with state-backend=bintrie-shadow — dual-root
+     commitment overhead, per-backend chain/commit/{mpt,bintrie} timers
 
 Each line: {"metric", "value", "unit", "vs_baseline", "config"} where
 vs_baseline compares the accelerated path against the host baseline of
@@ -80,11 +83,13 @@ def bench_2():
     _emit(2, "intermediate_root_1m_nodes_per_sec", dev, "nodes/s", dev / cpu)
 
 
-def _block_insert_rate(resident: bool = False):
+def _block_insert_rate(resident: bool = False, state_backend: str = "mpt"):
     """1k-tx block processing: build the blocks, then time insert_block
     (ecrecover via the native batch + EVM + state commit). Returns
     (n_txs, txs_per_sec). resident=True routes the account trie through
-    the device-resident mirror (CacheConfig.resident_account_trie)."""
+    the device-resident mirror (CacheConfig.resident_account_trie);
+    state_backend="bintrie-shadow" mounts the dual-root commitment
+    shadow (config-13 measures its overhead)."""
     from coreth_tpu import params
     from coreth_tpu.consensus.dummy import new_dummy_engine
     from coreth_tpu.core.blockchain import BlockChain, CacheConfig
@@ -109,7 +114,8 @@ def _block_insert_rate(resident: bool = False):
     )
     chain = BlockChain(
         diskdb,
-        CacheConfig(pruning=True, resident_account_trie=resident),
+        CacheConfig(pruning=True, resident_account_trie=resident,
+                    state_backend=state_backend),
         params.TEST_CHAIN_CONFIG,
         genesis, new_dummy_engine(),
         state_database=Database(TrieDatabase(diskdb)),
@@ -153,6 +159,9 @@ def _block_insert_rate(resident: bool = False):
     dt = time.perf_counter() - t0
     chain.stop()  # drains the write tail, so "write" stamps are final
     _LAST_INSERT_INFO["flight"] = chain.flight_recorder.last()
+    shadow = getattr(chain.state_database, "shadow", None)
+    _LAST_INSERT_INFO["shadow"] = (
+        shadow.status() if shadow is not None else None)
     return n_txs, n_txs / dt
 
 
@@ -603,6 +612,49 @@ def bench_12():
           res["speedup_warm_vs_legacy"])
 
 
+def bench_13():
+    """Dual-root shadow overhead (COMMITMENT.md): the config-3 insert
+    workload with state-backend=bintrie-shadow — every commit advances
+    BOTH the consensus MPT root and the experimental binary-Merkle root,
+    with divergence checks live. Reports the per-backend commit-timer
+    split (chain/commit/{mpt,bintrie}) and vs_baseline = shadow txs/s /
+    plain txs/s (<1; the gap IS the dual-commit overhead). The leg must
+    finish with zero quarantines — a quarantine here is a correctness
+    regression in the bintrie, not a perf number."""
+    from coreth_tpu.metrics import default_registry
+
+    def _commit_totals():
+        out = {}
+        for name in ("chain/commit/mpt", "chain/commit/bintrie"):
+            t = default_registry.timer(name)
+            out[name] = (t.count(), t.total())
+        return out
+
+    before = _commit_totals()
+    n_txs, shadow_rate = _block_insert_rate(state_backend="bintrie-shadow")
+    after = _commit_totals()
+    shadow_status = _LAST_INSERT_INFO.get("shadow") or {}
+    base_rate = _DEFAULT_INSERT_RATE
+    if base_rate is None:
+        _, base_rate = _block_insert_rate()
+    timers = {}
+    for name in ("chain/commit/mpt", "chain/commit/bintrie"):
+        c0, t0 = before[name]
+        c1, t1 = after[name]
+        timers[name.rsplit("/", 1)[1]] = {
+            "commits": c1 - c0, "total_s": round(t1 - t0, 4),
+        }
+    quarantines = 1 if shadow_status.get("quarantined") else 0
+    print(json.dumps({
+        "config": 13,
+        "commit_timers": timers,
+        "shadow": shadow_status,
+        "quarantines": quarantines,
+    }), flush=True)
+    _emit(13, "shadow_block_insert_txs_per_sec", shadow_rate, "txs/s",
+          shadow_rate / base_rate)
+
+
 def main():
     from coreth_tpu.utils import enable_compilation_cache
 
@@ -620,7 +672,7 @@ def main():
     watchdog = PhaseWatchdog(
         time.monotonic() + float(os.environ.get("CORETH_TPU_BENCH_WATCHDOG",
                                                 "1800")))
-    picks = [int(a) for a in sys.argv[1:]] or list(range(1, 13))
+    picks = [int(a) for a in sys.argv[1:]] or list(range(1, 14))
     for i in picks:
         # configs 7/9 run bench.py legs under their own phase watchdogs
         # with larger budgets (900s cold warmup); the outer arm must not

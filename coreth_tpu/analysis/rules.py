@@ -431,6 +431,7 @@ class HotPathPurityRule(Rule):
 CONSENSUS_FLOAT_PATHS = (
     "coreth_tpu/trie/", "coreth_tpu/rlp.py", "coreth_tpu/evm/gas.py",
     "coreth_tpu/params/", "coreth_tpu/core/types.py",
+    "coreth_tpu/bintrie/",
 )
 CONSENSUS_FLOAT_EXCLUDE = (
     "coreth_tpu/trie/resident_mirror.py", "coreth_tpu/trie/planned.py",
@@ -822,10 +823,61 @@ class ServingBoundednessRule(Rule):
         return iter(findings)
 
 
+# ------------------------------------------------------------------ SA008
+
+# Commitment-backend isolation (COMMITMENT.md): the MPT and the bintrie
+# implementations sit behind the state/commitment.py seam and may not
+# import each other — in either direction, by absolute or relative
+# import. Shared machinery goes through the interface or scheme-agnostic
+# layers (ops/, metrics/, native). The seam module itself is exempt: it
+# exists to know both.
+BACKEND_ISOLATION = (
+    # (package whose files are checked, banned import prefix)
+    ("coreth_tpu/bintrie/", "coreth_tpu.trie"),
+    ("coreth_tpu/trie/", "coreth_tpu.bintrie"),
+)
+
+
+class BackendIsolationRule(Rule):
+    id = "SA008"
+    title = "commitment backend reaches around the interface"
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        findings: List[Finding] = []
+        for pkg, banned in BACKEND_ISOLATION:
+            if not _in_scope(src.relpath, (pkg,)):
+                continue
+            # module path of this file, for resolving relative imports:
+            # "coreth_tpu/bintrie/tree.py" -> [coreth_tpu, bintrie, tree]
+            parts = src.relpath[:-3].split("/")
+            if parts[-1] == "__init__":
+                parts = parts[:-1]
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        self._flag(findings, src, node, alias.name, banned)
+                elif isinstance(node, ast.ImportFrom):
+                    if node.level == 0:
+                        full = node.module or ""
+                    else:
+                        base = parts[: len(parts) - node.level]
+                        full = ".".join(
+                            base + ([node.module] if node.module else []))
+                    self._flag(findings, src, node, full, banned)
+        return iter(findings)
+
+    def _flag(self, findings, src, node, module: str, banned: str) -> None:
+        if module == banned or module.startswith(banned + "."):
+            findings.append(self.finding(
+                src, node, "<module>",
+                f"imports {module} across the commitment-backend "
+                f"boundary — go through state/commitment.py instead"))
+
+
 ALL_RULES: Tuple[type, ...] = (
     SilentExceptRule, LockDisciplineRule, HotPathPurityRule,
     ConsensusFloatRule, UnorderedIterationRule, FailpointHygieneRule,
-    ServingBoundednessRule,
+    ServingBoundednessRule, BackendIsolationRule,
 )
 
 

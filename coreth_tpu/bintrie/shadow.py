@@ -1,0 +1,228 @@
+"""Dual-root shadow validation: a bintrie mounted beside the MPT.
+
+With `state-backend=bintrie-shadow` the StateDB commit path feeds every
+account/storage write it flushes into a ShadowCommitment. The shadow
+maintains its own binary-Merkle root per committed MPT root and runs
+three independent divergence checks:
+
+  1. replay determinism — committing the same (parent_root, new_root)
+     transition twice must reproduce the same bintrie root (block
+     generation and block insertion both commit every block, so this
+     fires constantly in tests and benches);
+  2. advance — when the MPT root moved and the update set is non-empty,
+     the bintrie root must move too;
+  3. canonical rebuild — every `check_interval` commits, re-fold the
+     full (key -> value_hash) map through tree.reference_root() and
+     compare against the incremental root.
+
+A failed check QUARANTINES the shadow: it stops updating, bumps
+`chain/commit/bintrie/quarantines`, and emits a `commitment/quarantine`
+flight event — consensus (the MPT root) is never affected. That is the
+whole point of shadow mode: a cheap, always-on correctness harness for
+the experimental backend under real workloads.
+
+Roots are keyed by MPT root (content-addressed store + roots map), not
+by a linear head, so reorgs / re-commits from older parents open the
+right historical bintrie state instead of diverging.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..metrics import count_drop, default_registry
+from ..native import keccak256
+from .planned import commit_with_fallback
+from .tree import EMPTY, BinaryTrie, NodeStore, reference_root
+
+ZERO32 = b"\x00" * 32
+
+# below this many updates a commit hashes on host: the planned executor
+# pays a fixed dispatch/transfer cost per call, which only amortizes on
+# bulk commits (the two paths are bit-exact, so this is purely a perf
+# routing decision — same rule as the MPT's BATCH_THRESHOLD)
+PLANNED_MIN_UPDATES = 64
+
+
+def encode_account(nonce: int, balance: int, code_hash: bytes,
+                   multicoin: bool) -> bytes:
+    """Fixed-width bintrie account leaf payload (no RLP):
+    nonce(8BE) || balance(32BE) || code_hash(32) || multicoin-flag(1)."""
+    return (nonce.to_bytes(8, "big") + balance.to_bytes(32, "big")
+            + code_hash + (b"\x01" if multicoin else b"\x00"))
+
+
+def storage_key(addr_hash: bytes, slot_hash: bytes) -> bytes:
+    """Single-tree storage addressing: storage lives in the same tree as
+    accounts under keccak256(addr_hash || slot_hash) — no per-account
+    subtree, so one commit hashes everything in one planned dispatch."""
+    return keccak256(addr_hash + slot_hash)
+
+
+class ShadowCommitment:
+    """The bintrie side of dual-root shadow validation.
+
+    Updates arrive as tuples from the StateDB commit loop:
+
+      ("account", addr_hash, (nonce, balance, code_hash, multicoin))
+      ("storage", addr_hash, slot_hash, value32)   # ZERO32 -> delete
+      ("destruct", addr_hash)                      # account + its slots
+    """
+
+    def __init__(self, check_interval: int = 16,
+                 note_event: Optional[Callable] = None):
+        self.store = NodeStore()
+        # mpt_root -> bintrie root for the same committed state
+        self.roots: Dict[bytes, bytes] = {}
+        # replay determinism: (parent_mpt, new_mpt) -> bintrie root
+        self._seen: Dict[Tuple[bytes, bytes], bytes] = {}
+        # bintrie storage keys alive per account, for destructs
+        self._storage_keys: Dict[bytes, Set[bytes]] = {}
+        # full key -> vhash map for the canonical-rebuild spot check
+        self._content: Dict[bytes, bytes] = {}
+        self.quarantined = False
+        self.quarantine_reason: Optional[str] = None
+        self.check_interval = check_interval
+        self._commits = 0
+        self._note_event = note_event
+        self._anchored = False
+
+    # ----------------------------------------------------------- queries
+
+    def root_for(self, mpt_root: bytes) -> Optional[bytes]:
+        """Bintrie root shadowing [mpt_root], or None if never seen."""
+        return self.roots.get(mpt_root)
+
+    def status(self) -> Dict[str, object]:
+        return {
+            "backend": "bintrie-shadow",
+            "quarantined": self.quarantined,
+            "quarantineReason": self.quarantine_reason,
+            "commits": self._commits,
+            "trackedRoots": len(self.roots),
+            "storeNodes": len(self.store),
+            "keys": len(self._content),
+        }
+
+    # ----------------------------------------------------------- commits
+
+    def on_commit(self, parent_root: bytes, new_root: bytes,
+                  updates: List[tuple], block_hash=None) -> Optional[bytes]:
+        """Apply one MPT commit's update stream to the shadow. Never
+        raises — any internal failure quarantines the shadow instead of
+        touching the (consensus-relevant) caller."""
+        if self.quarantined:
+            return None
+        try:
+            return self._on_commit(parent_root, new_root, updates,
+                                   block_hash)
+        except Exception as exc:  # noqa: BLE001 - shadow must not leak
+            count_drop("state/shadow/error")
+            self._quarantine(f"shadow error: {exc!r}", block_hash)
+            return None
+
+    def _on_commit(self, parent_root, new_root, updates, block_hash):
+        parent_broot = self.roots.get(parent_root)
+        if parent_broot is None:
+            if self._anchored:
+                # a parent we never shadowed (e.g. state loaded from
+                # disk): skip rather than diverge on partial content
+                default_registry.counter(
+                    "chain/commit/bintrie/unanchored").inc()
+                return None
+            # first commit ever anchors the shadow: the parent state is
+            # the empty tree (genesis commits from an empty StateDB).
+            # Register it so re-commits from the same parent (generate-
+            # then-insert replays the whole chain) stay anchored.
+            parent_broot = EMPTY
+            self.roots[parent_root] = EMPTY
+        self._anchored = True
+
+        trie = BinaryTrie(self.store, parent_broot)
+        content = dict(self._content) if parent_root == self._head() \
+            else self._rebuild_content(trie)
+        for up in updates:
+            self._apply(trie, content, up)
+        if len(updates) >= PLANNED_MIN_UPDATES:
+            broot = commit_with_fallback(trie)
+        else:
+            broot = trie.commit()
+
+        key = (parent_root, new_root)
+        prev = self._seen.get(key)
+        if prev is not None and prev != broot:
+            self._quarantine(
+                f"replay divergence: {prev.hex()[:16]} -> "
+                f"{broot.hex()[:16]} for same transition", block_hash)
+            return None
+        if parent_root != new_root and updates and broot == parent_broot:
+            self._quarantine(
+                "advance divergence: mpt root moved, bintrie root did not",
+                block_hash)
+            return None
+
+        self._seen[key] = broot
+        self.roots[new_root] = broot
+        self._content = content
+        self._head_root = new_root
+        self._commits += 1
+
+        if self.check_interval and self._commits % self.check_interval == 0:
+            want = reference_root(content, hashed_values=True)
+            if want != broot:
+                self._quarantine(
+                    f"rebuild divergence: incremental {broot.hex()[:16]} "
+                    f"!= canonical {want.hex()[:16]}", block_hash)
+                return None
+        return broot
+
+    def _head(self):
+        return getattr(self, "_head_root", None)
+
+    def _rebuild_content(self, trie: BinaryTrie) -> Dict[bytes, bytes]:
+        """Content map for a non-head parent (reorg / re-commit from an
+        older root): walk the tree at that root."""
+        return {k: vh for k, vh in trie.items()}
+
+    def _apply(self, trie, content, up):
+        kind = up[0]
+        if kind == "account":
+            _, ah, (nonce, balance, code_hash, multicoin) = up
+            value = encode_account(nonce, balance, code_hash, multicoin)
+            trie.update(ah, value)
+            content[ah] = keccak256(value)
+        elif kind == "storage":
+            _, ah, hk, v = up
+            bkey = storage_key(ah, hk)
+            if v == ZERO32 or not v:
+                trie.delete(bkey)
+                content.pop(bkey, None)
+                self._storage_keys.get(ah, set()).discard(bkey)
+            else:
+                trie.update(bkey, v)
+                content[bkey] = keccak256(v)
+                self._storage_keys.setdefault(ah, set()).add(bkey)
+        elif kind == "destruct":
+            _, ah = up
+            trie.delete(ah)
+            content.pop(ah, None)
+            for bkey in sorted(self._storage_keys.pop(ah, set())):
+                trie.delete(bkey)
+                content.pop(bkey, None)
+        else:
+            raise ValueError(f"unknown shadow update kind {kind!r}")
+
+    # -------------------------------------------------------- quarantine
+
+    def _quarantine(self, why: str, block_hash=None) -> None:
+        self.quarantined = True
+        self.quarantine_reason = why
+        default_registry.counter("chain/commit/bintrie/quarantines").inc()
+        if self._note_event is not None:
+            try:
+                bh = block_hash.hex() if isinstance(block_hash, bytes) \
+                    else block_hash
+                self._note_event("commitment/quarantine", why=why,
+                                 block=bh)
+            except Exception:  # noqa: BLE001 - telemetry must not raise
+                count_drop("state/shadow/event_error")

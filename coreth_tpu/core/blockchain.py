@@ -139,6 +139,16 @@ class CacheConfig:
     # deadline (seconds) for join_tail / acceptor-queue joins; on expiry
     # they raise TailStalled instead of blocking forever. 0 = unbounded
     tail_join_timeout: float = 0.0
+    # --- commitment backend (COMMITMENT.md) ---
+    # "mpt": consensus Merkle-Patricia trie only (default).
+    # "bintrie-shadow": mount the experimental binary-Merkle backend
+    # beside the MPT — every StateDB commit also advances a bintrie
+    # root, divergences quarantine the shadow via the flight-event path
+    # (commitment/quarantine), consensus roots are never affected.
+    state_backend: str = "mpt"
+    # shadow canonical-rebuild spot check every K commits (bintrie root
+    # re-folded from scratch vs the incremental root); 0 disables
+    shadow_check_interval: int = 16
 
 
 # counter/timer families snapshotted around each insert so the flight
@@ -248,6 +258,22 @@ class BlockChain:
                 batch_keccak=get_batch_keccak(cache_config.device_hasher),
             ))
         self.state_database = state_database
+
+        # dual-root shadow mount (before genesis setup, so the genesis
+        # commit anchors the shadow at the empty tree). The event hook
+        # late-binds the flight recorder: it is constructed further down
+        # but quarantine events can only fire from later commits.
+        if cache_config.state_backend == "bintrie-shadow":
+            from ..bintrie.shadow import ShadowCommitment
+
+            state_database.shadow = ShadowCommitment(
+                check_interval=cache_config.shadow_check_interval,
+                note_event=self._note_shadow_event,
+            )
+        elif cache_config.state_backend != "mpt":
+            raise ValueError(
+                f"unknown state-backend {cache_config.state_backend!r} "
+                "(expected 'mpt' or 'bintrie-shadow')")
 
         self.chainmu = threading.RLock()
 
@@ -599,6 +625,17 @@ class BlockChain:
         if self.mirror is None:
             return
         self._boot_mirror()
+
+    # ------------------------------------------- commitment shadow events
+
+    def _note_shadow_event(self, kind: str, **fields) -> None:
+        """ShadowCommitment event hook. Installed before the flight
+        recorder exists (the shadow mounts ahead of genesis setup), so
+        it resolves the recorder at call time; quarantine events only
+        fire from post-construction commits."""
+        rec = getattr(self, "flight_recorder", None)
+        if rec is not None:
+            rec.note_event(kind, **fields)
 
     # ------------------------------------------- device degradation ladder
 

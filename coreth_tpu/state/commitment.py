@@ -1,0 +1,121 @@
+"""Commitment-backend seam (COMMITMENT.md).
+
+The paper put the TPU behind trie.newHasher(); this module widens that
+seam to the whole authenticated data structure. A CommitmentBackend
+owns one commitment scheme (node layout, hashing, proofs) and hands out
+CommitmentTrie views over committed roots. state/database.py routes
+account-trie opens through the default backend, so StateDB and the
+executor stack never name a concrete trie type.
+
+Two implementations exist:
+
+  * MPTBackend (here) — the consensus Merkle-Patricia trie, wrapping
+    exactly what Database.open_trie did before the seam (including the
+    resident-mirror fast path);
+  * BinTrieBackend (coreth_tpu/bintrie/backend.py) — the experimental
+    binary Merkle tree, today mounted only in dual-root shadow mode
+    (bintrie/shadow.py), never consensus.
+
+SA008 keeps the implementations honest: coreth_tpu/trie/ and
+coreth_tpu/bintrie/ may not import each other — everything shared goes
+through this interface (or ops/, metrics/, native, which are scheme-
+agnostic).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..trie.node import EMPTY_ROOT
+
+BACKEND_MPT = "mpt"
+BACKEND_BINTRIE_SHADOW = "bintrie-shadow"
+BACKENDS = (BACKEND_MPT, BACKEND_BINTRIE_SHADOW)
+
+
+class CommitmentTrie:
+    """One mutable view over a committed root. The MPT's StateTrie /
+    MirrorStateTrie and the bintrie's BinaryTrie all satisfy this
+    contract; it exists for documentation and for isinstance-free
+    duck-typing at the seam."""
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def update(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def commit(self, collect_leaf: bool = False):
+        raise NotImplementedError
+
+
+class CommitmentBackend:
+    """Factory + proof surface for one commitment scheme."""
+
+    name: str = "?"
+
+    def open(self, root: bytes):
+        """CommitmentTrie over [root]."""
+        raise NotImplementedError
+
+    def empty_root(self) -> bytes:
+        raise NotImplementedError
+
+    def prove(self, root: bytes, key: bytes) -> List[bytes]:
+        """Proof blob(s) for [key] against [root]; scheme-specific
+        encoding, verifiable by verify()."""
+        raise NotImplementedError
+
+    def verify(self, root: bytes, key: bytes,
+               proof: List[bytes]) -> Tuple[bool, Optional[bytes]]:
+        """-> (present, value) after checking [proof] against [root];
+        raises a scheme-specific error on malformed/tampered proofs."""
+        raise NotImplementedError
+
+
+class MPTBackend(CommitmentBackend):
+    """Consensus Merkle-Patricia trie behind the seam. Opens resolve
+    through the TrieDatabase; when a ResidentAccountMirror is installed
+    (CacheConfig.resident_account_trie) roots the mirror holds open as
+    device-resident facades, exactly as Database.open_trie always did."""
+
+    name = BACKEND_MPT
+
+    def __init__(self, triedb):
+        self.triedb = triedb
+        self.mirror = None  # installed by the chain in resident mode
+
+    def open(self, root: bytes = EMPTY_ROOT):
+        if self.mirror is not None and self.mirror.has_root(root):
+            from .resident_trie import MirrorStateTrie
+
+            return MirrorStateTrie(self.mirror, root, self.triedb)
+        return self.triedb.open_state_trie(root)
+
+    def empty_root(self) -> bytes:
+        return EMPTY_ROOT
+
+    def prove(self, root: bytes, key: bytes) -> List[bytes]:
+        from ..trie.proof import prove as mpt_prove
+
+        return mpt_prove(self.open(root), key)
+
+    def verify(self, root: bytes, key: bytes, proof: List[bytes]):
+        from ..trie.proof import verify_proof
+
+        value = verify_proof(root, key, proof)
+        return (value is not None, value)
+
+
+def make_backend(name: str, triedb) -> CommitmentBackend:
+    """Backend registry. `bintrie-shadow` still returns the MPT backend
+    as the CONSENSUS backend — shadow mode mounts the bintrie beside it
+    (core/blockchain.py wires the ShadowCommitment), it never replaces
+    the root the chain commits."""
+    if name in (BACKEND_MPT, BACKEND_BINTRIE_SHADOW):
+        return MPTBackend(triedb)
+    raise ValueError(
+        f"unknown state backend {name!r} (expected one of {BACKENDS})")

@@ -12,6 +12,7 @@ from ..core import rawdb
 from ..trie.node import EMPTY_ROOT
 from ..trie.secure import StateTrie
 from ..trie.triedb import TrieDatabase
+from .commitment import MPTBackend
 
 CODE_CACHE_LIMIT = 64 * 1024 * 1024
 CODE_SIZE_CACHE = 100_000
@@ -21,20 +22,28 @@ class Database:
     def __init__(self, triedb: TrieDatabase):
         self.triedb = triedb
         self.diskdb = triedb.diskdb
-        # resident mode (CacheConfig.resident_account_trie): the chain
-        # installs its ResidentAccountMirror here; roots the mirror holds
-        # open as device-resident facades, everything else (historical /
-        # exported states) opens as the regular disk-backed trie
-        self.mirror = None
+        # account-trie opens route through the commitment-backend seam
+        # (state/commitment.py); the MPT backend is consensus. The
+        # chain's resident mirror installs onto backend.mirror via the
+        # `mirror` property below.
+        self.backend = MPTBackend(triedb)
+        # optional dual-root shadow (bintrie/shadow.py), mounted by the
+        # chain when CacheConfig.state_backend == "bintrie-shadow";
+        # StateDB.commit feeds it and it NEVER affects consensus roots
+        self.shadow = None
         self._code_cache: Dict[bytes, bytes] = {}
         self._code_cache_size = 0
 
-    def open_trie(self, root: bytes = EMPTY_ROOT):
-        if self.mirror is not None and self.mirror.has_root(root):
-            from .resident_trie import MirrorStateTrie
+    @property
+    def mirror(self):
+        return self.backend.mirror
 
-            return MirrorStateTrie(self.mirror, root, self.triedb)
-        return self.triedb.open_state_trie(root)
+    @mirror.setter
+    def mirror(self, m) -> None:
+        self.backend.mirror = m
+
+    def open_trie(self, root: bytes = EMPTY_ROOT):
+        return self.backend.open(root)
 
     def open_storage_trie(self, addr_hash: bytes, root: bytes) -> StateTrie:
         # hashdb scheme: storage tries resolve by node hash, same namespace

@@ -651,12 +651,25 @@ class StateDB:
         """
         from ..metrics import expensive_timer
 
+        # dual-root shadow (bintrie/shadow.py): collect this commit's
+        # account/storage update stream while the MPT flushes, then feed
+        # it to the shadow backend under its own timer. The per-backend
+        # chain/commit/{mpt,bintrie} timers are what the bench suite's
+        # shadow leg reports as the dual-commit overhead ratio.
+        shadow = getattr(self.db, "shadow", None)
+        shadow_updates: Optional[list] = (
+            [] if shadow is not None and not shadow.quarantined else None
+        )
+        _mpt_clock = _metrics.timer("chain/commit/mpt").time()
+        _mpt_clock.__enter__()
         self.intermediate_root(delete_empty)
         merged = MergedNodeSet()
         with expensive_timer("state/storage/commits"):
             for addr in sorted(self._objects_dirty):
                 obj = self._objects[addr]
                 if obj.deleted:
+                    if shadow_updates is not None:
+                        shadow_updates.append(("destruct", obj.addr_hash))
                     continue
                 if obj.dirty_code:
                     rawdb.write_code(self.db.diskdb, obj.data.code_hash, obj.code)
@@ -670,6 +683,15 @@ class StateDB:
                     for k, v in obj.snap_flush.items():
                         hk = keccak256(k)
                         stor[hk] = rlp.encode(v.lstrip(b"\x00")) if v != ZERO32 else b""
+                if shadow_updates is not None:
+                    d = obj.data
+                    shadow_updates.append((
+                        "account", obj.addr_hash,
+                        (d.nonce, d.balance, d.code_hash, d.is_multi_coin),
+                    ))
+                    for k, v in obj.snap_flush.items():
+                        shadow_updates.append(
+                            ("storage", obj.addr_hash, keccak256(k), v))
                 obj.snap_flush = {}
         with expensive_timer("state/account/commits"):
             if getattr(self.trie, "resident", False):
@@ -686,6 +708,11 @@ class StateDB:
         self._objects_dirty = set()
         if root != self.original_root and merged.sets:
             self.db.triedb.update(root, self.original_root, merged)
+        _mpt_clock.__exit__(None, None, None)
+        if shadow_updates is not None:
+            with _metrics.timer("chain/commit/bintrie").time():
+                shadow.on_commit(self.original_root, root, shadow_updates,
+                                 block_hash)
         self._deferred_snap_update = None
         if self.snaps is not None and self.snap is not None:
             # identical-root blocks still need their (empty) diff layer:

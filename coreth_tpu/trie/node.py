@@ -81,6 +81,35 @@ class MissingNodeError(Exception):
         self.path = path
 
 
+class ProofError(ValueError):
+    """Invalid merkle proof. Subclasses ValueError so pre-typed callers
+    (everything caught `except ValueError` before proof errors were
+    typed) keep working; new triage code catches the subclasses to tell
+    an incomplete proof set from a corrupt one."""
+
+
+class ProofMissingNodeError(ProofError):
+    """The proof set never supplied a referenced node blob — the proof
+    is INCOMPLETE (retry / refetch territory), not corrupt."""
+
+    def __init__(self, node_hash: bytes, context: str = ""):
+        self.node_hash = node_hash
+        self.context = context
+        suffix = f" ({context})" if context else ""
+        super().__init__(f"proof node missing: {node_hash.hex()}{suffix}")
+
+
+class ProofCorruptNodeError(ProofError):
+    """A supplied proof blob fails its hash check or does not decode —
+    the DATA is bad (peer misbehavior / bitrot), not merely absent."""
+
+    def __init__(self, node_hash: bytes, context: str = ""):
+        self.node_hash = node_hash
+        self.context = context
+        suffix = f" ({context})" if context else ""
+        super().__init__(f"proof node corrupt: {node_hash.hex()}{suffix}")
+
+
 def must_decode_node(node_hash: Optional[bytes], blob: bytes):
     """Decode an RLP-stored node; hash is cached into flags if given."""
     items = rlp.decode(blob)

@@ -9,10 +9,19 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+from ..metrics import count_drop
 from ..native import keccak256
 from .encoding import key_to_hex
 from .hasher import Hasher, node_to_bytes
-from .node import FullNode, HashNode, ShortNode, ValueNode, must_decode_node
+from .node import (
+    FullNode,
+    HashNode,
+    ProofCorruptNodeError,
+    ProofMissingNodeError,
+    ShortNode,
+    ValueNode,
+    must_decode_node,
+)
 from .trie import Trie
 
 
@@ -66,10 +75,19 @@ def verify_proof(root_hash: bytes, key: bytes, proof: Dict[bytes, bytes]) -> Opt
     while True:
         blob = proof.get(want)
         if blob is None:
-            raise ValueError(f"proof node missing: {want.hex()}")
+            # typed absent-vs-corrupt split (ISSUE 8 satellite): an
+            # incomplete proof set is refetch territory, a bad blob is
+            # peer misbehavior — triage needs to tell them apart
+            count_drop("trie/proof/missing_node")
+            raise ProofMissingNodeError(want, "verify_proof")
         if keccak256(blob) != want:
-            raise ValueError("proof node hash mismatch")
-        n = must_decode_node(want, blob)
+            count_drop("trie/proof/corrupt_node")
+            raise ProofCorruptNodeError(want, "hash mismatch")
+        try:
+            n = must_decode_node(want, blob)
+        except Exception as exc:
+            count_drop("trie/proof/corrupt_node")
+            raise ProofCorruptNodeError(want, f"undecodable: {exc}") from exc
         value, rest = _walk(n, hexkey, proof)
         if isinstance(rest, HashNode):
             want = bytes(rest)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
+from ..metrics import count_drop
 from ..native import keccak256
 from .encoding import key_to_hex
 from .node import (
@@ -26,6 +27,9 @@ from .node import (
     FullNode,
     HashNode,
     MissingNodeError,
+    ProofCorruptNodeError,
+    ProofError,
+    ProofMissingNodeError,
     ShortNode,
     ValueNode,
     must_decode_node,
@@ -34,16 +38,22 @@ from .node import (
 from .stacktrie import StackTrie
 from .trie import NodeReader, Trie
 
-
-class ProofError(ValueError):
-    pass
+# ProofError moved to trie/node.py (shared with proof.py) and grew typed
+# subclasses; re-exported here for existing importers (sync/client.py)
+__all_errors__ = (ProofError, ProofMissingNodeError, ProofCorruptNodeError)
 
 
 def _resolve_from_proof(proof: dict, node_hash: bytes):
     blob = proof.get(node_hash)
     if blob is None:
-        raise ProofError(f"proof node missing: {node_hash.hex()}")
-    return must_decode_node(node_hash, blob)
+        count_drop("trie/proof_range/missing_node")
+        raise ProofMissingNodeError(node_hash, "range proof")
+    try:
+        return must_decode_node(node_hash, blob)
+    except Exception as exc:
+        count_drop("trie/proof_range/corrupt_node")
+        raise ProofCorruptNodeError(
+            node_hash, f"undecodable: {exc}") from exc
 
 
 def _get(tn, key: bytes):

@@ -599,6 +599,81 @@ class DebugMetricsAPI:
         return server.serving_status()
 
 
+class DebugCommitmentAPI:
+    """Commitment-backend surface of the debug namespace (COMMITMENT.md):
+    both backends answer proofs through this one API — MPT node-list
+    proofs via debug_getProof, binary-Merkle compact witnesses via
+    debug_stateWitness — plus the dual-root shadow's live status."""
+
+    def __init__(self, vm, eth_api):
+        self.vm = vm
+        self._eth = eth_api
+
+    def _shadow(self):
+        shadow = getattr(self.vm.blockchain.state_database, "shadow", None)
+        if shadow is None:
+            raise RPCError(
+                -32000,
+                "no commitment shadow mounted (state-backend is not "
+                "bintrie-shadow)")
+        return shadow
+
+    def getProof(self, address: str, storage_keys: list,
+                 block: str = "latest") -> dict:
+        """debug_getProof: eth_getProof-shaped MPT account/storage proof
+        (same marshalling, served under the debug gate so proof triage
+        works even on nodes that trim the eth namespace)."""
+        return self._eth.getProof(address, storage_keys, block)
+
+    def stateWitness(self, address: str, block: str = "latest") -> dict:
+        """debug_stateWitness: compact binary-Merkle witness for
+        [address]'s account leaf against the shadow bintrie root of
+        [block]'s state. The blob is self-contained: verify_witness
+        (bintrie/witness.py) checks it against `bintrieRoot` with no
+        store access, and absorbing the witnesses a block touches
+        rebuilds enough tree to re-execute it statelessly."""
+        from ..bintrie.witness import prove as bintrie_prove
+        from ..eth.api import parse_addr
+        from ..native import keccak256
+
+        shadow = self._shadow()
+        blk = self.vm.eth_backend.block_by_tag(block)
+        if blk is None:
+            raise RPCError(-32000, "block not found")
+        broot = shadow.root_for(blk.root)
+        if broot is None:
+            raise RPCError(
+                -32000,
+                f"shadow has no bintrie root for state {blk.root.hex()} "
+                "(commit predates the shadow, or it is quarantined)")
+        addr = parse_addr(address)
+        witness = bintrie_prove(shadow.store, broot, keccak256(addr))
+        return {
+            "address": hb(addr),
+            "stateRoot": hb(blk.root),
+            "bintrieRoot": hb(broot),
+            "witness": hb(witness),
+        }
+
+    def commitmentStatus(self) -> dict:
+        """debug_commitmentStatus: which backend is mounted and, in
+        shadow mode, the shadow's commit/quarantine state and per-backend
+        commit-timer totals (the dual-commit overhead, live)."""
+        from ..metrics import default_registry
+
+        shadow = getattr(self.vm.blockchain.state_database, "shadow", None)
+        out = {
+            "backend": self.vm.blockchain.cache_config.state_backend,
+            "shadow": shadow.status() if shadow is not None else None,
+        }
+        timers = {}
+        for name in ("chain/commit/mpt", "chain/commit/bintrie"):
+            t = default_registry.timer(name)
+            timers[name] = {"count": t.count(), "total": t.total()}
+        out["commitTimers"] = timers
+        return out
+
+
 def create_handlers(vm, allow_unfinalized_queries: bool = False) -> RPCServer:
     """CreateHandlers (vm.go:1138): the full RPC surface on one server,
     namespace-gated by the eth-apis config list (config.go eth-apis,
@@ -644,6 +719,7 @@ def create_handlers(vm, allow_unfinalized_queries: bool = False) -> RPCServer:
     if apis & {"debug", "internal-debug", "debug-tracer"}:
         server.register_api("debug", DebugAPI(backend))
         server.register_api("debug", DebugMetricsAPI(vm))
+        server.register_api("debug", DebugCommitmentAPI(vm, eth))
     if apis & {"txpool", "internal-tx-pool"}:
         server.register_api("txpool", TxPoolAPI(backend))
     if "net" in apis:

@@ -130,6 +130,12 @@ class Config:
     # deadline (s) for insert-tail / acceptor-queue joins; on expiry the
     # join raises a diagnosable TailStalled instead of hanging. 0 off
     tail_join_timeout: float = 0.0
+    # commitment backend (COMMITMENT.md): "mpt" (consensus default) or
+    # "bintrie-shadow" (mount the experimental binary-Merkle backend
+    # beside the MPT; divergences quarantine, consensus is unaffected)
+    state_backend: str = "mpt"
+    # shadow canonical-rebuild spot check every K commits; 0 disables
+    shadow_check_interval: int = 16
 
     # --- tx pool ----------------------------------------------------------
     local_txs_enabled: bool = False
@@ -241,6 +247,14 @@ class Config:
             raise ValueError(
                 f"tail-join-timeout must be >= 0 "
                 f"(got {self.tail_join_timeout})")
+        if self.state_backend not in ("mpt", "bintrie-shadow"):
+            raise ValueError(
+                f"state-backend must be 'mpt' or 'bintrie-shadow' "
+                f"(got {self.state_backend!r})")
+        if self.shadow_check_interval < 0:
+            raise ValueError(
+                f"shadow-check-interval must be >= 0 "
+                f"(got {self.shadow_check_interval})")
         if self.span_ring_size <= 0:
             raise ValueError(
                 f"span-ring-size must be > 0 (got {self.span_ring_size})")

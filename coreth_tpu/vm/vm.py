@@ -216,6 +216,8 @@ class VM:
                 resident_spot_check_interval=(
                     full.resident_spot_check_interval),
                 tail_join_timeout=full.tail_join_timeout,
+                state_backend=full.state_backend,
+                shadow_check_interval=full.shadow_check_interval,
             ),
             self.chain_config,
             genesis,

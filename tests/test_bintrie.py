@@ -1,0 +1,271 @@
+"""Binary-Merkle commitment backend (coreth_tpu/bintrie/): differential
+property tests vs the pure-Python reference fold, planned-vs-host
+bit-exactness, witness verify/tamper, stateless partial trees."""
+
+import random
+
+import pytest
+
+from coreth_tpu.bintrie import (
+    EMPTY,
+    BinTrieMissingNode,
+    BinaryTrie,
+    NodeStore,
+    WitnessError,
+    absorb_witness,
+    prove,
+    reference_root,
+    verify_witness,
+)
+from coreth_tpu.bintrie.planned import commit_planned, commit_with_fallback
+from coreth_tpu.native import keccak256
+
+
+def _rand_key(rng):
+    return keccak256(rng.randbytes(8))
+
+
+class TestDifferential:
+    """Seeded random insert/delete/update sequences: the incremental
+    tree must match reference_root (which knows nothing about tree
+    machinery) after every commit."""
+
+    @pytest.mark.parametrize("seed", [1, 7, 42, 1337])
+    def test_random_ops_match_reference(self, seed):
+        rng = random.Random(seed)
+        store = NodeStore()
+        t = BinaryTrie(store)
+        model = {}
+        root = EMPTY
+        for round_i in range(8):
+            for _ in range(rng.randrange(10, 120)):
+                op = rng.randrange(10)
+                if op < 6 or not model:  # insert / overwrite
+                    k = _rand_key(rng)
+                    v = rng.randbytes(rng.randrange(1, 90))
+                    t.update(k, v)
+                    model[k] = v
+                elif op < 8:  # update existing
+                    k = rng.choice(list(model))
+                    v = rng.randbytes(rng.randrange(1, 90))
+                    t.update(k, v)
+                    model[k] = v
+                else:  # delete (sometimes absent)
+                    k = rng.choice(list(model)) if rng.random() < 0.8 \
+                        else _rand_key(rng)
+                    t.delete(k)
+                    model.pop(k, None)
+            root = t.commit()
+            assert root == reference_root(model)
+        # a fresh trie opened at the committed root reads everything
+        t2 = BinaryTrie(store, root)
+        for k, v in list(model.items())[:50]:
+            assert t2.get(k) == v
+
+    def test_order_independence(self):
+        rng = random.Random(3)
+        items = {_rand_key(rng): rng.randbytes(20) for _ in range(300)}
+        roots = set()
+        for seed in (1, 2, 3):
+            order = list(items)
+            random.Random(seed).shuffle(order)
+            t = BinaryTrie(NodeStore())
+            for k in order:
+                t.update(k, items[k])
+            roots.add(t.commit())
+        assert len(roots) == 1
+
+    def test_insert_all_delete_all_returns_empty(self):
+        rng = random.Random(9)
+        t = BinaryTrie(NodeStore())
+        keys = [_rand_key(rng) for _ in range(64)]
+        for k in keys:
+            t.update(k, b"v")
+        assert t.commit() != EMPTY
+        for k in keys:
+            assert t.delete(k)
+        assert t.commit() == EMPTY
+
+    def test_empty_value_means_delete(self):
+        t = BinaryTrie(NodeStore())
+        k = keccak256(b"k")
+        t.update(k, b"v")
+        t.update(k, b"")
+        assert t.commit() == EMPTY
+
+    def test_canonical_collapse_across_commits(self):
+        """Delete from a REOPENED tree (children are store refs, not
+        node objects) still collapses to the canonical shape."""
+        rng = random.Random(5)
+        store = NodeStore()
+        t = BinaryTrie(store)
+        model = {_rand_key(rng): b"v%d" % i for i in range(40)}
+        for k, v in model.items():
+            t.update(k, v)
+        root = t.commit()
+        t2 = BinaryTrie(store, root)
+        for k in list(model)[:30]:
+            t2.delete(k)
+            del model[k]
+        assert t2.commit() == reference_root(model)
+
+
+class TestPlanned:
+    def test_planned_matches_host_10k_keys(self):
+        """ISSUE 8 acceptance: planned digests bit-exact vs the host
+        keccak over >= 10k keys — every internal node AND the root."""
+        rng = random.Random(1234)
+        items = {_rand_key(rng): rng.randbytes(32) for _ in range(10_000)}
+        host = BinaryTrie(NodeStore())
+        dev = BinaryTrie(NodeStore())
+        for k, v in items.items():
+            host.update(k, v)
+            dev.update(k, v)
+        assert commit_planned(dev) == host.commit() == reference_root(items)
+        # bit-exactness is per-node, not just the root: both stores hold
+        # identical preimage sets keyed by identical digests
+        assert dev.store.nodes == host.store.nodes
+
+    def test_planned_incremental_recommit(self):
+        rng = random.Random(77)
+        store = NodeStore()
+        t = BinaryTrie(store)
+        model = {_rand_key(rng): b"a" for _ in range(500)}
+        for k, v in model.items():
+            t.update(k, v)
+        r1 = commit_planned(t)
+        t2 = BinaryTrie(store, r1)
+        for k in list(model)[:100]:
+            t2.update(k, b"b")
+            model[k] = b"b"
+        extra = {_rand_key(rng): b"c" for _ in range(100)}
+        for k, v in extra.items():
+            t2.update(k, v)
+        model.update(extra)
+        assert commit_planned(t2) == reference_root(model)
+
+    def test_planned_empty_and_clean(self):
+        t = BinaryTrie(NodeStore())
+        assert commit_planned(t) == EMPTY
+        t.update(keccak256(b"x"), b"v")
+        r = commit_planned(t)
+        assert commit_planned(t) == r  # clean tree: no dispatch needed
+
+    def test_fallback_matches_host(self, monkeypatch):
+        from coreth_tpu.bintrie import planned as planned_mod
+
+        rng = random.Random(8)
+        items = {_rand_key(rng): b"v" for _ in range(50)}
+        t = BinaryTrie(NodeStore())
+        for k, v in items.items():
+            t.update(k, v)
+
+        def boom(*a, **kw):
+            raise RuntimeError("device on fire")
+
+        monkeypatch.setattr(planned_mod, "commit_planned", boom)
+        assert commit_with_fallback(t) == reference_root(items)
+
+
+class TestWitness:
+    def _tree(self, n=200, seed=21):
+        rng = random.Random(seed)
+        store = NodeStore()
+        t = BinaryTrie(store)
+        items = {_rand_key(rng): rng.randbytes(40) for _ in range(n)}
+        for k, v in items.items():
+            t.update(k, v)
+        return store, t.commit(), items
+
+    def test_inclusion_and_absence(self):
+        store, root, items = self._tree()
+        for k in list(items)[:30]:
+            ok, val = verify_witness(root, k, prove(store, root, k))
+            assert ok and val == items[k]
+        for probe in (b"absent-1", b"absent-2", b"absent-3"):
+            k = keccak256(probe)
+            assert k not in items
+            ok, val = verify_witness(root, k, prove(store, root, k))
+            assert not ok and val is None
+
+    def test_empty_tree_witness(self):
+        store = NodeStore()
+        k = keccak256(b"anything")
+        ok, val = verify_witness(EMPTY, k, prove(store, EMPTY, k))
+        assert not ok and val is None
+
+    def test_tampering_rejected(self):
+        store, root, items = self._tree()
+        k = next(iter(items))
+        w = prove(store, root, k)
+        # flip one bit at every byte position: nothing may verify
+        for pos in range(0, len(w), max(1, len(w) // 48)):
+            bad = bytearray(w)
+            bad[pos] ^= 0x40
+            with pytest.raises(WitnessError):
+                verify_witness(root, k, bytes(bad))
+        # truncations
+        for cut in (0, 10, len(w) - 1):
+            with pytest.raises(WitnessError):
+                verify_witness(root, k, w[:cut])
+        # witness for a different key
+        other = [x for x in items if x != k][0]
+        with pytest.raises(WitnessError):
+            verify_witness(root, other, w)
+        # wrong root
+        with pytest.raises(WitnessError):
+            verify_witness(keccak256(b"other root"), k, w)
+
+    def test_historical_roots_stay_provable(self):
+        """The store is append-only: witnesses verify against any
+        previously committed root, not just the head."""
+        rng = random.Random(31)
+        store = NodeStore()
+        t = BinaryTrie(store)
+        k0 = _rand_key(rng)
+        t.update(k0, b"old")
+        root_old = t.commit()
+        t2 = BinaryTrie(store, root_old)
+        t2.update(k0, b"new")
+        root_new = t2.commit()
+        assert verify_witness(
+            root_old, k0, prove(store, root_old, k0)) == (True, b"old")
+        assert verify_witness(
+            root_new, k0, prove(store, root_new, k0)) == (True, b"new")
+
+    def test_absorb_builds_stateless_partial_tree(self):
+        store, root, items = self._tree()
+        touched = list(items)[:5]
+        partial = NodeStore()
+        for k in touched:
+            absorb_witness(partial, root, prove(store, root, k))
+        st = BinaryTrie(partial, root)
+        for k in touched:
+            assert st.get(k) == items[k]
+        # an uncovered path must fail loudly, not return garbage
+        uncovered = [x for x in items if x not in touched][0]
+        with pytest.raises(BinTrieMissingNode):
+            st.get(uncovered)
+
+    def test_stateless_mutation_reaches_correct_root(self):
+        """Witness-backed partial tree supports WRITES: updating a
+        proven key folds to the same root the full tree reaches."""
+        store, root, items = self._tree(n=100, seed=65)
+        k = next(iter(items))
+        partial = NodeStore()
+        absorb_witness(partial, root, prove(store, root, k))
+        st = BinaryTrie(partial, root)
+        st.update(k, b"rewritten")
+        full = BinaryTrie(store, root)
+        full.update(k, b"rewritten")
+        assert st.commit() == full.commit()
+
+    def test_absorbed_witness_must_verify_first(self):
+        store, root, items = self._tree(n=20, seed=2)
+        k = next(iter(items))
+        w = bytearray(prove(store, root, k))
+        w[-1] ^= 1
+        partial = NodeStore()
+        with pytest.raises(WitnessError):
+            absorb_witness(partial, root, bytes(w))
+        assert len(partial) == 0  # nothing polluted the store

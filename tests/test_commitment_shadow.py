@@ -1,0 +1,435 @@
+"""Dual-root shadow validation (state-backend=bintrie-shadow): chain-level
+shadow runs, divergence quarantines, stateless re-execution from witnesses,
+and the debug_* commitment RPC surface (COMMITMENT.md)."""
+
+import json
+
+import pytest
+
+from coreth_tpu import params
+from coreth_tpu.bintrie import (
+    EMPTY,
+    BinaryTrie,
+    NodeStore,
+    WitnessError,
+    absorb_witness,
+    prove,
+    verify_witness,
+)
+from coreth_tpu.bintrie.shadow import ShadowCommitment, encode_account
+from coreth_tpu.consensus.dummy import new_dummy_engine
+from coreth_tpu.core.blockchain import BlockChain, CacheConfig
+from coreth_tpu.core.chain_makers import generate_chain
+from coreth_tpu.core.genesis import Genesis, GenesisAccount
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.metrics import default_registry
+from coreth_tpu.native import keccak256
+from coreth_tpu.state.database import Database
+from coreth_tpu.trie.triedb import TrieDatabase
+
+from tests.test_blockchain import (
+    ADDR1,
+    ADDR2,
+    FUND,
+    KEY1,
+    transfer_tx,
+)
+
+COINBASE = b"\x00" * 20
+EMPTY_CODE_HASH = keccak256(b"")
+
+
+def make_shadow_chain(check_interval=8):
+    diskdb = MemoryDB()
+    state_db = Database(TrieDatabase(diskdb))
+    genesis = Genesis(
+        config=params.TEST_CHAIN_CONFIG,
+        gas_limit=params.CORTINA_GAS_LIMIT,
+        alloc={ADDR1: GenesisAccount(balance=FUND),
+               ADDR2: GenesisAccount(balance=FUND)},
+    )
+    chain = BlockChain(
+        diskdb,
+        CacheConfig(pruning=True, state_backend="bintrie-shadow",
+                    shadow_check_interval=check_interval),
+        params.TEST_CHAIN_CONFIG,
+        genesis,
+        new_dummy_engine(),
+        state_database=state_db,
+    )
+    return chain
+
+
+def build_blocks(chain, n, gen):
+    blocks, _ = generate_chain(
+        chain.config, chain.current_block, chain.engine,
+        chain.state_database, n, gen=gen,
+    )
+    return blocks
+
+
+def decode_account(value: bytes):
+    """Inverse of bintrie.shadow.encode_account."""
+    assert len(value) == 73
+    return (int.from_bytes(value[:8], "big"),
+            int.from_bytes(value[8:40], "big"),
+            value[40:72],
+            value[72] == 1)
+
+
+def _counter(name):
+    return default_registry.counter(name).count()
+
+
+class TestShadowChain:
+    def test_fifty_block_shadow_run(self):
+        """ISSUE 8 acceptance: a >= 50-block run in shadow mode finishes
+        with zero quarantines, both per-backend commit timers populated,
+        and a verifiable account witness at the head root."""
+        chain = make_shadow_chain()
+        shadow = chain.state_database.shadow
+        assert shadow is not None and not shadow.quarantined
+
+        q0 = _counter("chain/commit/bintrie/quarantines")
+        mpt0 = default_registry.timer("chain/commit/mpt").count()
+        bin0 = default_registry.timer("chain/commit/bintrie").count()
+
+        def gen(i, bg):
+            bg.add_tx(transfer_tx(i, ADDR2, KEY1, bg.base_fee()))
+
+        blocks = build_blocks(chain, 50, gen)
+        for b in blocks:
+            chain.insert_block(b)
+        for b in blocks:
+            chain.accept(b)
+        chain.drain_acceptor_queue()
+        assert chain.last_accepted.number == 50
+
+        # never quarantined, and every MPT commit had a bintrie twin
+        assert shadow.quarantined is False
+        assert shadow.quarantine_reason is None
+        assert _counter("chain/commit/bintrie/quarantines") == q0
+        mpt_d = default_registry.timer("chain/commit/mpt").count() - mpt0
+        bin_d = default_registry.timer("chain/commit/bintrie").count() - bin0
+        assert mpt_d >= 100  # genesis + 50 generated + 50 inserted
+        assert bin_d == mpt_d
+
+        # the head MPT root has a shadow root, and a witness for ADDR2's
+        # account verifies against it with the expected leaf payload
+        head_root = blocks[-1].header.root
+        broot = shadow.root_for(head_root)
+        assert broot is not None and broot != EMPTY
+        k2 = keccak256(ADDR2)
+        w = prove(shadow.store, broot, k2)
+        ok, value = verify_witness(broot, k2, w)
+        assert ok
+        nonce, balance, code_hash, multi = decode_account(value)
+        assert (nonce, balance) == (0, FUND + 50 * 1000)
+        assert code_hash == EMPTY_CODE_HASH and multi is False
+
+        # tampering any byte of the witness must be rejected
+        bad = bytearray(w)
+        bad[len(bad) // 2] ^= 0x20
+        with pytest.raises(WitnessError):
+            verify_witness(broot, k2, bytes(bad))
+        chain.stop()
+
+    def test_historical_roots_stay_witnessable(self):
+        """Every committed block's state keeps a provable shadow root
+        (content-addressed store — not just the head)."""
+        chain = make_shadow_chain()
+        shadow = chain.state_database.shadow
+
+        def gen(i, bg):
+            bg.add_tx(transfer_tx(i, ADDR2, KEY1, bg.base_fee()))
+
+        blocks = build_blocks(chain, 5, gen)
+        for b in blocks:
+            chain.insert_block(b)
+        k2 = keccak256(ADDR2)
+        for i, b in enumerate(blocks):
+            broot = shadow.root_for(b.header.root)
+            assert broot is not None
+            ok, value = verify_witness(
+                broot, k2, prove(shadow.store, broot, k2))
+            assert ok
+            assert decode_account(value)[1] == FUND + (i + 1) * 1000
+        chain.stop()
+
+    def test_mpt_default_mounts_no_shadow(self):
+        diskdb = MemoryDB()
+        state_db = Database(TrieDatabase(diskdb))
+        genesis = Genesis(
+            config=params.TEST_CHAIN_CONFIG,
+            gas_limit=params.CORTINA_GAS_LIMIT,
+            alloc={ADDR1: GenesisAccount(balance=FUND)},
+        )
+        chain = BlockChain(diskdb, CacheConfig(pruning=True),
+                           params.TEST_CHAIN_CONFIG, genesis,
+                           new_dummy_engine(), state_database=state_db)
+        assert chain.state_database.shadow is None
+        assert chain.cache_config.state_backend == "mpt"
+        chain.stop()
+
+    def test_unknown_backend_rejected(self):
+        diskdb = MemoryDB()
+        state_db = Database(TrieDatabase(diskdb))
+        genesis = Genesis(
+            config=params.TEST_CHAIN_CONFIG,
+            gas_limit=params.CORTINA_GAS_LIMIT,
+            alloc={ADDR1: GenesisAccount(balance=FUND)},
+        )
+        with pytest.raises(ValueError, match="state-backend"):
+            BlockChain(diskdb, CacheConfig(state_backend="verkle"),
+                       params.TEST_CHAIN_CONFIG, genesis,
+                       new_dummy_engine(), state_database=state_db)
+
+
+class TestStatelessReplay:
+    def test_block_replays_from_witnesses_alone(self):
+        """ISSUE 8 acceptance: re-execute a block against a tree built
+        ONLY from witnesses (no NodeStore access) and land on the same
+        bintrie root the shadow computed for the post-state."""
+        chain = make_shadow_chain()
+        shadow = chain.state_database.shadow
+        value, tip = 777, 5
+
+        def gen(i, bg):
+            bg.add_tx(transfer_tx(i, ADDR2, KEY1, bg.base_fee(),
+                                  value=value, tip=tip))
+
+        blocks = build_blocks(chain, 3, gen)
+        for b in blocks:
+            chain.insert_block(b)
+
+        # replay block 2 (its parent already paid fees to the coinbase,
+        # so all three touched accounts exist in the parent state)
+        target, parent = blocks[1], blocks[0]
+        broot_parent = shadow.root_for(parent.header.root)
+        broot_new = shadow.root_for(target.header.root)
+        assert broot_parent and broot_new and broot_parent != broot_new
+
+        keys = {name: keccak256(addr) for name, addr in
+                (("sender", ADDR1), ("recipient", ADDR2),
+                 ("coinbase", COINBASE))}
+        partial = NodeStore()
+        for k in keys.values():
+            absorb_witness(partial, broot_parent,
+                           prove(shadow.store, broot_parent, k))
+
+        # stateless pre-state reads — partial store only, full store unused
+        st = BinaryTrie(partial, broot_parent)
+        pre = {name: decode_account(st.get(k)) for name, k in keys.items()}
+
+        header = target.header
+        assert header.gas_used == 21000
+        # type-2 effective gas price: base_fee + min(tip, max_fee-base_fee)
+        fee = header.gas_used * (header.base_fee + tip)
+
+        n, b, ch, mc = pre["sender"]
+        st.update(keys["sender"],
+                  encode_account(n + 1, b - value - fee, ch, mc))
+        n, b, ch, mc = pre["recipient"]
+        st.update(keys["recipient"], encode_account(n, b + value, ch, mc))
+        n, b, ch, mc = pre["coinbase"]
+        st.update(keys["coinbase"], encode_account(n, b + fee, ch, mc))
+
+        assert st.commit() == broot_new
+        chain.stop()
+
+
+class TestShadowUnit:
+    """ShadowCommitment divergence checks, driven directly (no chain)."""
+
+    A, B, C = b"\xaa" * 32, b"\xbb" * 32, b"\xcc" * 32
+    AH = keccak256(b"acct-1")
+
+    def _acct(self, nonce=1, balance=100):
+        return ("account", self.AH, (nonce, balance, EMPTY_CODE_HASH, False))
+
+    def test_commit_and_root_tracking(self):
+        s = ShadowCommitment()
+        r1 = s.on_commit(self.A, self.B, [self._acct()])
+        assert r1 is not None and r1 != EMPTY
+        r2 = s.on_commit(self.B, self.C, [self._acct(nonce=2)])
+        assert r2 not in (None, r1)
+        assert s.root_for(self.A) == EMPTY  # anchored parent
+        assert s.root_for(self.B) == r1
+        assert s.root_for(self.C) == r2
+        assert s.status()["commits"] == 2
+
+    def test_replay_same_transition_is_deterministic(self):
+        s = ShadowCommitment()
+        ups = [self._acct()]
+        r1 = s.on_commit(self.A, self.B, ups)
+        r2 = s.on_commit(self.A, self.B, ups)  # generate-then-insert replay
+        assert r1 == r2 and not s.quarantined
+
+    def test_replay_divergence_quarantines(self):
+        events = []
+        s = ShadowCommitment(note_event=lambda kind, **f: events.append(
+            (kind, f)))
+        q0 = _counter("chain/commit/bintrie/quarantines")
+        s.on_commit(self.A, self.B, [self._acct(balance=100)])
+        out = s.on_commit(self.A, self.B, [self._acct(balance=999)],
+                          block_hash=b"\x11" * 32)
+        assert out is None
+        assert s.quarantined and "replay divergence" in s.quarantine_reason
+        assert _counter("chain/commit/bintrie/quarantines") == q0 + 1
+        assert events and events[0][0] == "commitment/quarantine"
+        assert events[0][1]["block"] == ("11" * 32)
+        # quarantined shadow ignores further commits
+        assert s.on_commit(self.B, self.C, [self._acct()]) is None
+        assert s.status()["quarantined"] is True
+
+    def test_advance_divergence_quarantines(self):
+        s = ShadowCommitment()
+        s.on_commit(self.A, self.B, [self._acct()])
+        # MPT root moved, update set non-empty, but the writes are
+        # identical to the parent state: the bintrie root cannot advance
+        out = s.on_commit(self.B, self.C, [self._acct()])
+        assert out is None
+        assert s.quarantined and "advance" in s.quarantine_reason
+
+    def test_unanchored_parent_skipped_not_quarantined(self):
+        s = ShadowCommitment()
+        s.on_commit(self.A, self.B, [self._acct()])
+        u0 = _counter("chain/commit/bintrie/unanchored")
+        assert s.on_commit(b"\xee" * 32, b"\xef" * 32,
+                           [self._acct()]) is None
+        assert _counter("chain/commit/bintrie/unanchored") == u0 + 1
+        assert not s.quarantined
+        # the known lineage still advances afterwards
+        assert s.on_commit(self.B, self.C,
+                           [self._acct(nonce=2)]) is not None
+
+    def test_internal_error_quarantines_never_raises(self):
+        s = ShadowCommitment()
+        out = s.on_commit(self.A, self.B, [("warp-drive", b"x")])
+        assert out is None
+        assert s.quarantined and "shadow error" in s.quarantine_reason
+
+    def test_destruct_removes_account_and_its_storage(self):
+        s = ShadowCommitment()
+        slot = keccak256(b"slot")
+        s.on_commit(self.A, self.B, [
+            self._acct(),
+            ("storage", self.AH, slot, b"\x07" * 32),
+        ])
+        r = s.on_commit(self.B, self.C, [("destruct", self.AH)])
+        assert r == EMPTY  # nothing else lived in the tree
+        assert not s.quarantined
+
+    def test_storage_zero_write_deletes(self):
+        from coreth_tpu.bintrie.shadow import ZERO32, storage_key
+        from coreth_tpu.bintrie import reference_root
+
+        s = ShadowCommitment()
+        slot = keccak256(b"s")
+        s.on_commit(self.A, self.B, [
+            self._acct(),
+            ("storage", self.AH, slot, b"\x01" + b"\x00" * 31),
+        ])
+        r = s.on_commit(self.B, self.C,
+                        [("storage", self.AH, slot, ZERO32)])
+        acct_value = encode_account(1, 100, EMPTY_CODE_HASH, False)
+        assert r == reference_root({self.AH: acct_value})
+        assert storage_key(self.AH, slot) not in s._content
+
+    def test_rebuild_spot_check_passes_on_honest_stream(self):
+        s = ShadowCommitment(check_interval=1)  # re-fold on every commit
+        parents = [self.A, self.B, self.C, b"\xdd" * 32]
+        for i in range(3):
+            s.on_commit(parents[i], parents[i + 1],
+                        [self._acct(nonce=i + 1, balance=50 * (i + 1))])
+        assert not s.quarantined and s.status()["commits"] == 3
+
+
+class TestCommitmentRPC:
+    """debug_getProof / debug_stateWitness / debug_commitmentStatus over
+    a live VM booted through the Initialize JSON blob."""
+
+    KEY = b"\x31" * 32
+    ADDR = priv_to_address(KEY)
+
+    def _boot(self, **extra):
+        from coreth_tpu.vm.api import create_handlers
+        from coreth_tpu.vm.shared_memory import Memory
+        from coreth_tpu.vm.vm import VM, SnowContext
+
+        vm = VM()
+        genesis = Genesis(
+            config=params.TEST_CHAIN_CONFIG,
+            gas_limit=params.CORTINA_GAS_LIMIT,
+            alloc={self.ADDR: GenesisAccount(balance=FUND)},
+        )
+        cfg = {"eth-apis": ["eth", "debug"]}
+        cfg.update(extra)
+        vm.initialize(SnowContext(shared_memory=Memory()), MemoryDB(),
+                      genesis, config=None,
+                      config_bytes=json.dumps(cfg).encode())
+        return vm, create_handlers(vm)
+
+    def _rpc(self, server, method, *params_):
+        raw = server.handle_raw(json.dumps(
+            {"jsonrpc": "2.0", "id": 1, "method": method,
+             "params": list(params_)}).encode())
+        return json.loads(raw)
+
+    def test_status_and_witness_in_shadow_mode(self):
+        vm, server = self._boot(**{"state-backend": "bintrie-shadow"})
+        try:
+            st = self._rpc(server, "debug_commitmentStatus")["result"]
+            assert st["backend"] == "bintrie-shadow"
+            assert st["shadow"]["quarantined"] is False
+            assert st["shadow"]["commits"] >= 1  # genesis commit
+            for name in ("chain/commit/mpt", "chain/commit/bintrie"):
+                assert st["commitTimers"][name]["count"] >= 1
+
+            out = self._rpc(server, "debug_stateWitness",
+                            "0x" + self.ADDR.hex(), "latest")["result"]
+            assert out["address"] == "0x" + self.ADDR.hex()
+            broot = bytes.fromhex(out["bintrieRoot"][2:])
+            witness = bytes.fromhex(out["witness"][2:])
+            ok, value = verify_witness(
+                broot, keccak256(self.ADDR), witness)
+            assert ok
+            assert decode_account(value)[1] == FUND
+
+            # debug_getProof serves the eth_getProof-shaped MPT proof
+            proof = self._rpc(server, "debug_getProof",
+                              "0x" + self.ADDR.hex(), [],
+                              "latest")["result"]
+            assert proof["accountProof"]
+            assert int(proof["balance"], 16) == FUND
+        finally:
+            vm.shutdown()
+            server.stop()
+
+    def test_witness_for_absent_account_proves_absence(self):
+        vm, server = self._boot(**{"state-backend": "bintrie-shadow"})
+        try:
+            ghost = b"\x99" * 20
+            out = self._rpc(server, "debug_stateWitness",
+                            "0x" + ghost.hex(), "latest")["result"]
+            ok, value = verify_witness(
+                bytes.fromhex(out["bintrieRoot"][2:]), keccak256(ghost),
+                bytes.fromhex(out["witness"][2:]))
+            assert ok is False and value is None
+        finally:
+            vm.shutdown()
+            server.stop()
+
+    def test_witness_errors_without_shadow(self):
+        vm, server = self._boot()  # default state-backend=mpt
+        try:
+            resp = self._rpc(server, "debug_stateWitness",
+                             "0x" + self.ADDR.hex(), "latest")
+            assert resp["error"]["code"] == -32000
+            assert "no commitment shadow" in resp["error"]["message"]
+            st = self._rpc(server, "debug_commitmentStatus")["result"]
+            assert st["backend"] == "mpt" and st["shadow"] is None
+        finally:
+            vm.shutdown()
+            server.stop()

@@ -434,6 +434,64 @@ def test_sa007_fires_on_executor_with_explicit_none():
     assert [f.rule for f in out] == ["SA007"]
 
 
+# ---------------------------------------------------------------- SA008
+
+def test_sa008_fires_on_bintrie_importing_mpt():
+    src = """
+    from coreth_tpu.trie.node import HashNode
+
+    def helper():
+        return HashNode
+    """
+    out = [f for f in findings(src, "coreth_tpu/bintrie/fixture.py")
+           if f.rule == "SA008"]
+    assert out and "coreth_tpu.trie" in out[0].message
+
+
+def test_sa008_fires_on_mpt_importing_bintrie():
+    src = """
+    import coreth_tpu.bintrie.tree as bt
+
+    def helper():
+        return bt.EMPTY
+    """
+    out = [f for f in findings(src, "coreth_tpu/trie/fixture.py")
+           if f.rule == "SA008"]
+    assert out
+
+
+def test_sa008_resolves_relative_imports():
+    """`from ..trie import node` inside bintrie/ is the same breach as
+    the absolute spelling — the rule resolves relative levels."""
+    src = """
+    from ..trie import node
+
+    def helper():
+        return node
+    """
+    out = [f for f in findings(src, "coreth_tpu/bintrie/fixture.py")
+           if f.rule == "SA008"]
+    assert out
+
+
+def test_sa008_quiet_on_shared_deps_and_seam_module():
+    # backends may share the leaf dependencies (native, metrics, ops)
+    src = """
+    from coreth_tpu.native import keccak256
+    from coreth_tpu.metrics import count_drop
+    from ..ops.keccak_planned import SegmentSpec
+    """
+    assert [f for f in findings(src, "coreth_tpu/bintrie/fixture.py")
+            if f.rule == "SA008"] == []
+    # and the seam (state/commitment.py) legitimately sees both sides
+    src2 = """
+    from coreth_tpu.trie.secure import StateTrie
+    from coreth_tpu.bintrie.tree import BinaryTrie
+    """
+    assert [f for f in findings(src2, "coreth_tpu/state/fixture.py")
+            if f.rule == "SA008"] == []
+
+
 # ------------------------------------------------------------ repo gate
 
 def test_repo_is_clean_modulo_baseline():

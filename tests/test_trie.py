@@ -236,6 +236,88 @@ def test_proofs():
         verify_proof(root, k, bad)
 
 
+def test_proof_errors_are_typed():
+    """Missing vs corrupt proof nodes raise distinct exception types (both
+    still ValueError for existing catch sites), and the drop counters
+    meter each class."""
+    from coreth_tpu.metrics import default_registry
+    from coreth_tpu.trie.node import (
+        ProofCorruptNodeError,
+        ProofError,
+        ProofMissingNodeError,
+    )
+
+    def drops(name):
+        return default_registry.counter(name).count()
+
+    t = Trie()
+    items = {b"k-%03d" % i: b"v%d" % i for i in range(60)}
+    for k, v in items.items():
+        t.update(k, v)
+    root = t.hash()
+    k = b"k-017"
+    db = {keccak256(b): b for b in prove(t, k)}
+
+    # missing node: drop an interior blob from the proof
+    victim = [h for h in db if h != root][0]
+    incomplete = {h: b for h, b in db.items() if h != victim}
+    base = drops("trie/proof/missing_node")
+    with pytest.raises(ProofMissingNodeError) as ei:
+        verify_proof(root, k, incomplete)
+    assert ei.value.node_hash == victim
+    assert drops("trie/proof/missing_node") == base + 1
+
+    # corrupt node: blob present but does not hash to its key
+    bad = dict(db)
+    bad[victim] = bad[victim][:-1] + bytes([bad[victim][-1] ^ 1])
+    base = drops("trie/proof/corrupt_node")
+    with pytest.raises(ProofCorruptNodeError):
+        verify_proof(root, k, bad)
+    assert drops("trie/proof/corrupt_node") == base + 1
+
+    # undecodable blob keyed by its true hash is corrupt, not missing
+    junk = b"\xff\xfe\xfd"
+    bad2 = dict(db)
+    bad2[victim] = junk
+    with pytest.raises(ProofCorruptNodeError):
+        verify_proof(root, k, bad2)
+
+    # the hierarchy: both are ProofError, both are ValueError
+    for exc_type in (ProofMissingNodeError, ProofCorruptNodeError):
+        assert issubclass(exc_type, ProofError)
+        assert issubclass(exc_type, ValueError)
+
+
+def test_range_proof_errors_are_typed():
+    """proof_range re-exports the shared typed errors (sync/client.py
+    imports ProofError from there) and raises the missing-node subclass
+    when an edge-proof blob is absent."""
+    from coreth_tpu.trie import proof_range
+    from coreth_tpu.trie.node import ProofError, ProofMissingNodeError
+
+    assert proof_range.ProofError is ProofError
+
+    t = Trie()
+    items = {b"rk-%03d" % i: b"v%d" % i for i in range(40)}
+    for k, v in items.items():
+        t.update(k, v)
+    root = t.hash()
+    keys = sorted(items)[5:15]
+    values = [items[k] for k in keys]
+    proof = {}
+    for edge in (keys[0], keys[-1]):
+        for blob in prove(t, edge):
+            proof[keccak256(blob)] = blob
+    assert proof_range.verify_range_proof(
+        root, keys[0], keys[-1], keys, values, proof) is True
+
+    victim = [h for h in proof if h != root][0]
+    incomplete = {h: b for h, b in proof.items() if h != victim}
+    with pytest.raises(ProofMissingNodeError):
+        proof_range.verify_range_proof(
+            root, keys[0], keys[-1], keys, values, incomplete)
+
+
 def test_iterator_order_and_start():
     rng = random.Random(13)
     items = {bytes(rng.randrange(256) for _ in range(4)): b"v" for _ in range(200)}
