@@ -720,13 +720,17 @@ class FailpointHygieneRule(Rule):
 # The serving tier's overload story *is* its bounded queues (PR 7,
 # ROBUSTNESS.md "Serving under overload"): an unbounded queue or an
 # uncapped worker source in a request-serving module quietly
-# reintroduces collapse-under-saturation. Client-side and batch code is
-# out of scope — only modules that accept remote work are listed.
+# reintroduces collapse-under-saturation. Only modules that accept or
+# fan out remote work are listed: peer/ (gossip + request fan-out pools)
+# and sync/ (segment + hedge pools driven by remote responses) joined in
+# PR 9 — a Byzantine peer set must not be able to balloon either.
 SERVING_PATHS = (
     "coreth_tpu/rpc/",
     "coreth_tpu/vm/api.py",
     "coreth_tpu/eth/filters.py",
     "coreth_tpu/metrics/http.py",
+    "coreth_tpu/peer/",
+    "coreth_tpu/sync/",
 )
 _QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue"}
 

@@ -6,6 +6,14 @@ request-id correlation, deadlines, and bandwidth-aware peer selection.
 Here the transport is pluggable: production would bind a socket transport;
 tests wire VMs back-to-back in-process exactly like the reference's
 syncervm tests (syncervm_test.go:269 createSyncServerAndClientVMs).
+
+Peer selection runs a scoring ladder with the same shape as the device
+degradation ladder (ops/device.py) and the RPC breaker (rpc/server.py):
+HEALTHY -> SUSPECT -> QUARANTINED, fed by typed failure classes where
+proof/validation failures weigh hardest (a lying peer is worse than a
+slow one). Quarantine is time-boxed with escalating strikes; re-admission
+is probe-based — a quarantined peer must answer consecutive probes
+correctly before rejoining the healthy rotation.
 """
 
 from __future__ import annotations
@@ -22,32 +30,106 @@ def _count(name: str) -> None:
     count_drop(name)
 
 
+# Typed failure classes for the peer ladder. Proof rejections weigh
+# hardest: a peer that serves data failing cryptographic validation is
+# actively lying, while transport faults may just be congestion.
+FAIL_TRANSPORT = "transport"
+FAIL_DEADLINE = "deadline"
+FAIL_DECODE = "decode"
+FAIL_PROOF = "proof"
+
+FAILURE_WEIGHTS: Dict[str, float] = {
+    FAIL_TRANSPORT: 1.0,
+    FAIL_DEADLINE: 2.0,
+    FAIL_DECODE: 3.0,
+    FAIL_PROOF: 4.0,
+}
+
+# Ladder states (mirrors ops/device.py DeviceLadder naming).
+PEER_HEALTHY = "healthy"
+PEER_SUSPECT = "suspect"
+PEER_QUARANTINED = "quarantined"
+
+
 class NetworkError(Exception):
-    pass
+    """Transport-level failure. ``kind`` is the peer-ladder failure class
+    (FAIL_TRANSPORT or FAIL_DEADLINE); validation layers raise their own
+    errors and score the peer with FAIL_DECODE/FAIL_PROOF."""
+
+    def __init__(self, message: str, kind: str = FAIL_TRANSPORT):
+        super().__init__(message)
+        self.kind = kind
 
 
 @dataclass
 class PeerStats:
-    """peer_tracker.go bandwidth tracking."""
+    """peer_tracker.go bandwidth tracking + ladder state."""
 
     requests: int = 0
     failures: int = 0
     total_bytes: int = 0
     total_seconds: float = 0.0
+    state: str = PEER_HEALTHY
+    score: float = 0.0
+    strikes: int = 0
+    probe_passes: int = 0
+    quarantine_until: float = 0.0
+    fail_kinds: Dict[str, int] = field(default_factory=dict)
 
     @property
     def bandwidth(self) -> float:
-        if self.total_seconds == 0:
+        if self.requests == 0:
             return float("inf")  # untested peers rank first (exploration)
+        if self.total_seconds == 0:
+            return 0.0  # tested but never a successful transfer
         return self.total_bytes / self.total_seconds
+
+    @property
+    def failure_rate(self) -> float:
+        if self.requests == 0:
+            return 0.0
+        return self.failures / self.requests
+
+    def rank(self) -> float:
+        """Selection key: bandwidth discounted by failure rate and the
+        live ladder score, so a fast lying peer stops winning rotation."""
+        bw = self.bandwidth
+        if bw == float("inf"):
+            return bw
+        return bw * (1.0 - self.failure_rate) / (1.0 + self.score)
 
 
 class PeerTracker:
-    """Bandwidth-aware peer selection (peer_tracker.go:70-198)."""
+    """Bandwidth-aware peer selection (peer_tracker.go:70-198) with a
+    healthy/suspect/quarantined scoring ladder."""
 
     def __init__(self):
         self.peers: Dict[bytes, PeerStats] = {}
         self.lock = threading.Lock()
+        # Ladder tuning (overridden by PeerTracker.configure from the
+        # validated sync-* config knobs).
+        self.suspect_score = 4.0
+        self.quarantine_score = 8.0
+        self.quarantine_seconds = 30.0
+        self.readmit_probes = 2
+        self.success_decay = 0.5
+
+    def configure(self, *, suspect_score: Optional[float] = None,
+                  quarantine_score: Optional[float] = None,
+                  quarantine_seconds: Optional[float] = None,
+                  readmit_probes: Optional[int] = None,
+                  success_decay: Optional[float] = None) -> None:
+        with self.lock:
+            if suspect_score is not None:
+                self.suspect_score = suspect_score
+            if quarantine_score is not None:
+                self.quarantine_score = quarantine_score
+            if quarantine_seconds is not None:
+                self.quarantine_seconds = quarantine_seconds
+            if readmit_probes is not None:
+                self.readmit_probes = readmit_probes
+            if success_decay is not None:
+                self.success_decay = success_decay
 
     def connected(self, node_id: bytes) -> None:
         with self.lock:
@@ -57,31 +139,116 @@ class PeerTracker:
         with self.lock:
             self.peers.pop(node_id, None)
 
-    def track_request(self, node_id: bytes, size: int, seconds: float,
-                      ok: bool) -> None:
+    # --- ladder -----------------------------------------------------------
+
+    def record_success(self, node_id: bytes, size: int, seconds: float) -> None:
         with self.lock:
             st = self.peers.setdefault(node_id, PeerStats())
             st.requests += 1
-            if ok:
-                st.total_bytes += size
-                st.total_seconds += max(seconds, 1e-6)
-            else:
-                st.failures += 1
+            st.total_bytes += size
+            st.total_seconds += max(seconds, 1e-6)
+            if st.state == PEER_QUARANTINED:
+                # A quarantined peer only ever sees traffic as a probe
+                # (probe window or last-resort fallback); consecutive
+                # correct answers earn re-admission.
+                st.probe_passes += 1
+                if st.probe_passes >= self.readmit_probes:
+                    st.state = PEER_SUSPECT
+                    st.score = self.suspect_score / 2.0
+                    st.quarantine_until = 0.0
+                    st.probe_passes = 0
+                    _count("peer/ladder/readmissions")
+                return
+            st.score = max(0.0, st.score * self.success_decay)
+            if st.state == PEER_SUSPECT and st.score < self.suspect_score:
+                st.state = PEER_HEALTHY
+
+    def record_failure(self, node_id: bytes, kind: str = FAIL_TRANSPORT) -> None:
+        weight = FAILURE_WEIGHTS.get(kind, 1.0)
+        with self.lock:
+            st = self.peers.setdefault(node_id, PeerStats())
+            st.requests += 1
+            st.failures += 1
+            st.fail_kinds[kind] = st.fail_kinds.get(kind, 0) + 1
+            st.score += weight
+            now = time.monotonic()
+            if st.state == PEER_QUARANTINED:
+                st.probe_passes = 0
+                if now >= st.quarantine_until:
+                    # failed its probe: escalate the quarantine window
+                    st.strikes += 1
+                    st.quarantine_until = now + self._quarantine_span(st)
+                    _count("peer/ladder/probe_failures")
+                return
+            if st.score >= self.quarantine_score:
+                st.state = PEER_QUARANTINED
+                st.quarantine_until = now + self._quarantine_span(st)
+                st.strikes += 1
+                st.probe_passes = 0
+                _count("peer/ladder/quarantines")
+            elif st.score >= self.suspect_score and st.state == PEER_HEALTHY:
+                st.state = PEER_SUSPECT
+                _count("peer/ladder/suspects")
+        _count("peer/failures/%s" % kind)
+
+    def _quarantine_span(self, st: PeerStats) -> float:
+        return self.quarantine_seconds * (2.0 ** min(st.strikes, 6))
+
+    def track_request(self, node_id: bytes, size: int, seconds: float,
+                      ok: bool) -> None:
+        """Compatibility shim for pre-ladder callers: failures route
+        through the ladder as transport faults."""
+        if ok:
+            self.record_success(node_id, size, seconds)
+        else:
+            self.record_failure(node_id, FAIL_TRANSPORT)
+
+    # --- selection --------------------------------------------------------
 
     def best_peer(self, exclude: Optional[set] = None) -> Optional[bytes]:
+        now = time.monotonic()
         with self.lock:
-            candidates = [
-                (st.bandwidth, nid) for nid, st in self.peers.items()
-                if not exclude or nid not in exclude
-            ]
-        if not candidates:
-            return None
-        candidates.sort(key=lambda x: -x[0] if x[0] != float("inf") else float("-inf"))
-        # prefer untested peers, then highest bandwidth
-        untested = [nid for bw, nid in candidates if bw == float("inf")]
-        if untested:
-            return untested[0]
-        return candidates[0][1]
+            tiers: Dict[int, List[Tuple[float, int, bytes]]] = {}
+            for order, (nid, st) in enumerate(self.peers.items()):
+                if exclude and nid in exclude:
+                    continue
+                if st.state == PEER_QUARANTINED:
+                    # expired quarantine = probe window; active quarantine
+                    # is kept as a LAST resort so an all-quarantined peer
+                    # set degrades to probing instead of deadlocking.
+                    tier = 3 if now >= st.quarantine_until else 4
+                elif st.requests == 0:
+                    tier = 0
+                elif st.state == PEER_HEALTHY:
+                    tier = 1
+                else:
+                    tier = 2
+                tiers.setdefault(tier, []).append((st.rank(), -order, nid))
+        for tier in sorted(tiers):
+            best = max(tiers[tier])
+            return best[2]
+        return None
+
+    def status(self) -> Dict[str, dict]:
+        """Ladder snapshot for debug_syncStatus."""
+        now = time.monotonic()
+        out: Dict[str, dict] = {}
+        with self.lock:
+            for nid, st in self.peers.items():
+                bw = st.bandwidth
+                out[nid.hex()] = {
+                    "state": st.state,
+                    "score": round(st.score, 3),
+                    "strikes": st.strikes,
+                    "requests": st.requests,
+                    "failures": st.failures,
+                    "failKinds": dict(st.fail_kinds),
+                    "bandwidth": None if bw == float("inf") else round(bw, 1),
+                    "quarantineRemaining": round(
+                        max(0.0, st.quarantine_until - now), 3)
+                    if st.state == PEER_QUARANTINED else 0.0,
+                }
+        return out
 
 
 class Network:
@@ -99,11 +266,14 @@ class Network:
         self._req_id = 0
         self.lock = threading.Lock()
         self._pool = None  # lazy executor for deadlines + async requests
+        self.gossip_deadline = 2.0
 
     def _executor(self):
         if self._pool is None:
             from concurrent.futures import ThreadPoolExecutor
 
+            # bounded: 16 workers caps concurrent in-flight transport
+            # calls; excess callers queue (SA007 serving-boundedness)
             self._pool = ThreadPoolExecutor(max_workers=16)
         return self._pool
 
@@ -158,7 +328,8 @@ class Network:
         try:
             return fut.result(timeout=deadline)
         except _FTimeout:
-            raise NetworkError("cross-chain request deadline exceeded")
+            raise NetworkError("cross-chain request deadline exceeded",
+                               kind=FAIL_DEADLINE)
         except Exception as e:
             raise NetworkError(f"cross-chain request failed: {e}") from e
 
@@ -187,15 +358,15 @@ class Network:
         try:
             response = fut.result(timeout=deadline)
         except _FTimeout:
-            self.tracker.track_request(node_id, 0, deadline, False)
+            self.tracker.record_failure(node_id, FAIL_DEADLINE)
             self._fire_failed(node_id, request)
-            raise NetworkError("request deadline exceeded")
+            raise NetworkError("request deadline exceeded", kind=FAIL_DEADLINE)
         except Exception as e:
-            self.tracker.track_request(node_id, 0, time.monotonic() - start, False)
+            self.tracker.record_failure(node_id, FAIL_TRANSPORT)
             self._fire_failed(node_id, request)
             raise NetworkError(f"request to {node_id!r} failed: {e}") from e
         elapsed = time.monotonic() - start
-        self.tracker.track_request(node_id, len(response), elapsed, True)
+        self.tracker.record_success(node_id, len(response), elapsed)
         return response
 
     def send_request_async(self, node_id: bytes, request: bytes,
@@ -224,9 +395,24 @@ class Network:
         return self._executor().submit(run)
 
     def gossip(self, payload: bytes) -> None:
-        for node_id, transport in list(self._transports.items()):
+        """Fan out without letting one wedged transport stall the loop:
+        every send runs on the executor and the whole fan-out shares one
+        bounded deadline; a peer that hasn't answered by then is counted
+        under peer/gossip_timeouts and abandoned (gossip is fire-and-
+        forget, so the payload is not retried)."""
+        from concurrent.futures import TimeoutError as _FTimeout
+
+        futs = [
+            (node_id, self._executor().submit(transport, self.self_id,
+                                              b"\xff" + payload))
+            for node_id, transport in list(self._transports.items())
+        ]
+        end = time.monotonic() + self.gossip_deadline
+        for node_id, fut in futs:
             try:
-                transport(self.self_id, b"\xff" + payload)  # gossip marker
+                fut.result(timeout=max(0.0, end - time.monotonic()))
+            except _FTimeout:
+                _count("peer/gossip_timeouts")
             except Exception:
                 _count("peer/drops/gossip_send_failure")
 

@@ -81,6 +81,120 @@ class FaultyTransport:
         raise ValueError(f"unknown fault verb {verb!r}")
 
 
+class AdversarialPeer:
+    """Byzantine peer simulator for the sync bootstrap drills: wraps an
+    honest request handler with one MODE of sustained misbehavior, so a
+    peer set can be assembled where liars outnumber honest nodes and the
+    client must still converge bit-exactly.
+
+    Modes (each maps to a ladder failure class the client should assign):
+
+        honest            pass through (control peer)
+        lying_leafs       flip a byte in a leaf value — range-proof
+                          validation must reject it (proof weight)
+        bad_proof         corrupt a proof node (proof weight)
+        truncated_stream  the INVISIBLE truncation: rewrite the request
+                          to fetch fewer leaves, answer honestly for the
+                          smaller range (proofs verify!), then claim
+                          more=False. Per-batch validation cannot catch
+                          this on end-bounded segments — the
+                          drain-confirmation cross-exam and the terminal
+                          rebuild root check must
+        stall             sleep past the request deadline, then answer
+                          (deadline weight)
+        flap              fail every call at the transport level — the
+                          connect/refuse flapping reconnector
+                          (transport weight)
+        empty             answer the don't-have wire shape for leafs and
+                          empty responses otherwise (stale/pruned peer;
+                          also the lying-empty attack)
+        garbage           undecodable bytes (decode weight)
+
+    Tampering is deterministic (fixed byte positions, no RNG) so seeded
+    drills replay exactly."""
+
+    def __init__(self, inner: Callable[[bytes, bytes], bytes], mode: str,
+                 stall_seconds: float = 1.0):
+        if mode not in ("honest", "lying_leafs", "bad_proof",
+                        "truncated_stream", "stall", "flap", "empty",
+                        "garbage"):
+            raise ValueError(f"unknown adversarial mode {mode!r}")
+        self.inner = inner
+        self.mode = mode
+        self.stall_seconds = stall_seconds
+        self.calls = 0
+        self.tampered = 0
+        self._lock = threading.Lock()
+
+    def __call__(self, sender: bytes, request: bytes) -> bytes:
+        from ..sync.messages import (
+            BlockRequest,
+            BlockResponse,
+            CodeRequest,
+            CodeResponse,
+            LeafsRequest,
+            LeafsResponse,
+            decode_message,
+        )
+
+        with self._lock:
+            self.calls += 1
+        mode = self.mode
+        if mode == "honest":
+            return self.inner(sender, request)
+        if mode == "flap":
+            with self._lock:
+                self.tampered += 1
+            raise TransportFault("flapping peer: connection refused")
+        if mode == "garbage":
+            with self._lock:
+                self.tampered += 1
+            return b"\x63" + b"garbage"  # unknown type tag
+        if mode == "stall":
+            with self._lock:
+                self.tampered += 1
+            time.sleep(self.stall_seconds)
+            return self.inner(sender, request)
+        if mode == "empty":
+            with self._lock:
+                self.tampered += 1
+            req = decode_message(request)
+            if isinstance(req, LeafsRequest):
+                return LeafsResponse().encode()  # the don't-have shape
+            if isinstance(req, BlockRequest):
+                return BlockResponse().encode()
+            if isinstance(req, CodeRequest):
+                return CodeResponse().encode()
+            return self.inner(sender, request)
+        # leafs-tampering modes: non-leafs traffic passes through
+        req = decode_message(request)
+        if not isinstance(req, LeafsRequest):
+            return self.inner(sender, request)
+        if mode == "truncated_stream":
+            limit = req.limit or 1024
+            req.limit = max(1, limit // 4)
+            resp = decode_message(self.inner(sender, req.encode()))
+            if resp.more:
+                with self._lock:
+                    self.tampered += 1
+                resp.more = False  # "that's all there is", honestly proofed
+            return resp.encode()
+        resp = decode_message(self.inner(sender, request))
+        if mode == "lying_leafs" and resp.vals:
+            v = resp.vals[len(resp.vals) // 2]
+            if v:
+                with self._lock:
+                    self.tampered += 1
+                resp.vals[len(resp.vals) // 2] = (
+                    v[:-1] + bytes([v[-1] ^ 0xFF]))
+        elif mode == "bad_proof" and resp.proof_vals:
+            p = resp.proof_vals[0]
+            with self._lock:
+                self.tampered += 1
+            resp.proof_vals[0] = p[:-1] + bytes([p[-1] ^ 0xFF]) if p else b"\x01"
+        return resp.encode()
+
+
 class DisruptiveServer(TransportServer):
     """TransportServer that can hard-close every live connection on
     demand — the wire-level analogue of a peer crash / NAT rebind.

@@ -30,18 +30,30 @@ from __future__ import annotations
 
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List, Optional, Set
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..core import rawdb
+from ..fault import failpoint
+from ..fault import register as _register_failpoint
+from ..metrics import count_drop, default_registry
 from ..native import keccak256
+from ..peer.network import FAIL_PROOF
 from ..state.account import Account
 from ..state.snapshot import account_snapshot_key, storage_snapshot_key
 from ..state.statedb import _account_to_slim
 from ..trie.node import EMPTY_ROOT
 from ..trie.stacktrie import StackTrie
-from .client import ClientError, SyncClient
+from .client import ClientError, RootUnavailableError, SyncClient
 
 EMPTY_CODE_HASH = keccak256(b"")
+
+FP_BEFORE_PIVOT = _register_failpoint(
+    "sync/before_pivot",
+    "before an in-flight sync re-targets to a newer summary root")
+FP_BEFORE_REBUILD = _register_failpoint(
+    "sync/before_rebuild",
+    "before the terminal full-keyspace StackTrie rebuild of a "
+    "segmented sync")
 
 NUM_SEGMENTS = 4          # trie_segments.go numSegments split
 SEGMENT_THRESHOLD = 2048  # leaves before a trie is considered "large"
@@ -85,17 +97,57 @@ class StateSyncer:
 
     def __init__(self, client: SyncClient, diskdb, root: bytes,
                  num_threads: int = 4, leaf_limit: int = DEFAULT_LEAF_LIMIT,
-                 segment_threshold: int = SEGMENT_THRESHOLD):
+                 segment_threshold: int = SEGMENT_THRESHOLD,
+                 drain_confirm: bool = True,
+                 note_event: Optional[Callable] = None):
         self.client = client
         self.diskdb = diskdb
         self.root = root
         self.leaf_limit = leaf_limit
         self.segment_threshold = segment_threshold
-        self.pool = ThreadPoolExecutor(max_workers=num_threads)
+        self.num_threads = num_threads
+        self.drain_confirm = drain_confirm
+        self.pool: Optional[ThreadPoolExecutor] = None  # lazy; see close()
         self.lock = threading.Lock()
         self.code_hashes: Set[bytes] = set()
         self.storage_tasks: List = []  # (account_hash, storage_root)
         self.synced_storage_roots: Set[bytes] = set()
+        self.pivots: List[Tuple[bytes, bytes]] = []  # (old_root, new_root)
+        self.phase = "idle"
+        self._note_event = note_event
+
+    def _note(self, kind: str, **fields) -> None:
+        """Flight-recorder hook (wired by syncervm); never lets an
+        observer fault break the sync."""
+        if self._note_event is None:
+            return
+        try:
+            self._note_event(kind, **fields)
+        except Exception:
+            count_drop("sync/drops/note_event_error")
+
+    def _workers(self) -> ThreadPoolExecutor:
+        with self.lock:
+            if self.pool is None:
+                # bounded: num_threads caps concurrent storage-trie
+                # fetches (SA007 serving-boundedness)
+                self.pool = ThreadPoolExecutor(max_workers=self.num_threads)
+            return self.pool
+
+    def close(self) -> None:
+        """Release the worker pool (the pre-fix leak: threads outlived
+        the sync). Safe to call repeatedly; a later sync()/pivot() lazily
+        re-creates the pool."""
+        with self.lock:
+            pool, self.pool = self.pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
+
+    def __enter__(self) -> "StateSyncer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     # --- trie leaf streaming ---------------------------------------------
 
@@ -237,6 +289,7 @@ class StateSyncer:
         start = marker[1:] if marker else seg_start
         count = 0
         empty_more = 0
+        disagreements = 0
         while True:
             resp = self.client.get_leafs(
                 root, start=start, end=seg_end, limit=self.leaf_limit)
@@ -263,9 +316,59 @@ class StateSyncer:
                         "keeps answering empty with more=True"
                     )
                 continue
+            # The peer claims the segment is drained. A lying more=False
+            # on an end-bounded request is invisible to per-batch proof
+            # validation (keys legitimately exist past the segment end),
+            # so before stamping DONE, cross-examine a SECOND peer —
+            # skipped when the response provably reached the segment end,
+            # or when there is no second peer to ask (single-peer wirings
+            # keep their exact request counts).
+            nxt = _next_key(resp.keys[-1]) if resp.keys else start
+            reached_end = bool(resp.keys) and resp.keys[-1] >= seg_end
+            if (self.drain_confirm and not reached_end
+                    and self._peer_count() >= 2
+                    and not self._confirm_drained(
+                        root, nxt, seg_end, getattr(resp, "peer", None))):
+                disagreements += 1
+                if disagreements > 16:
+                    raise StateSyncError(
+                        f"segment {seg_start.hex()[:8]}: drained claims "
+                        "keep being contradicted by other peers")
+                batch.put(key, b"S" + nxt)
+                batch.write()
+                start = nxt
+                continue
             batch.put(key, _SEG_DONE)
             batch.write()
             return count
+
+    def _peer_count(self) -> int:
+        counter = getattr(self.client, "peer_count", None)
+        # clients without a peer set (test fakes) have no second opinion
+        return counter() if counter is not None else 1
+
+    def _confirm_drained(self, root: bytes, start: bytes, seg_end: bytes,
+                         claimer: Optional[bytes]) -> bool:
+        """Ask a peer OTHER than [claimer] whether [start, seg_end] is
+        really empty. Proof-backed leaves from the confirmer are hard
+        evidence the claimer truncated its stream — score it at proof
+        weight. An honest-but-empty disagreement cannot be fabricated:
+        the confirmer's keys must themselves range-proof against root."""
+        try:
+            confirm = self.client.get_leafs(
+                root, start=start, end=seg_end, limit=self.leaf_limit,
+                exclude={claimer} if claimer else None)
+        except RootUnavailableError:
+            raise
+        except ClientError:
+            return True  # no usable second opinion: accept the claim
+        if confirm.keys or confirm.more:
+            self.client.report_peer(claimer, FAIL_PROOF)
+            default_registry.counter("sync/drain_disagreements").inc()
+            self._note("sync/drain_disagreement", root=root.hex()[:12],
+                       claimer=claimer.hex() if claimer else "?")
+            return False
+        return True
 
     def _rebuild_from_buffer(self, root: bytes, seg_starts, on_leaf,
                              on_unleaf=None) -> int:
@@ -277,6 +380,8 @@ class StateSyncer:
         nodes, the buffer strictly after — a crash mid-cleanup leaves
         either a fully-markered buffer (rebuild replays) or no markers
         plus stray buffer entries (cleared at the next sync's switch)."""
+        failpoint("sync/before_rebuild")
+        self._note("sync/rebuild_start", root=root.hex()[:12])
         batch = self.diskdb.new_batch()
 
         def write_node(path: bytes, node_hash: bytes, blob: bytes) -> None:
@@ -303,6 +408,9 @@ class StateSyncer:
             # an honest peer) refetches instead of wedging forever on
             # done-marked holes. The buffer clear also undoes the
             # snapshot entries the unverified leaves wrote (on_unleaf).
+            default_registry.counter("sync/rebuild_mismatch").inc()
+            self._note("sync/rebuild_mismatch", want=root.hex()[:12],
+                       got=got.hex()[:12])
             batch = self.diskdb.new_batch()
             for s in seg_starts:
                 batch.delete(sync_segment_key(root, s))
@@ -319,6 +427,66 @@ class StateSyncer:
         # 2) buffer clear, strictly after the markers are gone
         self._clear_leaf_buffer(root)
         return count
+
+    # --- dynamic pivot ------------------------------------------------------
+
+    def pivot(self, new_root: bytes) -> None:
+        """Re-target an in-flight sync to [new_root] (the stale-root
+        escape hatch): SEGMENTED progress — resume markers and the
+        on-disk leaf buffer — carries forward under the new root instead
+        of restarting from zero. Carried leaves are best-effort: any that
+        changed between summaries make the terminal rebuild root check
+        fail, which resets segment state and refetches (the standard
+        lying-peer self-heal). Unsegmented resume markers are dropped —
+        that path persists leaves un-buffered, so its partial progress
+        cannot be re-verified under a different root.
+
+        Copy-then-delete ordering keeps a crash mid-pivot safe: strays
+        under either root are unreferenced garbage cleared at the next
+        switch, never lost markered data."""
+        old = self.root
+        if new_root == old:
+            return
+        failpoint("sync/before_pivot")
+        seg_starts = _segment_bounds(NUM_SEGMENTS)
+        batch = self.diskdb.new_batch()
+        for s in seg_starts:
+            v = self.diskdb.get(sync_segment_key(old, s))
+            if v is not None:
+                batch.put(sync_segment_key(new_root, s), v)
+        batch.write()
+        old_prefix = SYNC_LEAF_PREFIX + old
+        batch = self.diskdb.new_batch()
+        carried = 0
+        for full_key, v in self.diskdb.iterate(old_prefix):
+            batch.put(sync_leaf_key(new_root, full_key[len(old_prefix):]), v)
+            carried += 1
+            if carried % 4096 == 0:
+                batch.write()
+                batch = self.diskdb.new_batch()
+        batch.write()
+        batch = self.diskdb.new_batch()
+        for s in seg_starts:
+            batch.delete(sync_segment_key(old, s))
+        batch.delete(sync_storage_key(old, b""))
+        n = 0
+        for full_key, _v in self.diskdb.iterate(old_prefix):
+            batch.delete(full_key)
+            n += 1
+            if n % 4096 == 0:
+                batch.write()
+                batch = self.diskdb.new_batch()
+        batch.write()
+        with self.lock:
+            # task state was derived under the old root; sync() re-derives
+            self.storage_tasks = []
+            self.code_hashes = set()
+            self.synced_storage_roots = set()
+            self.root = new_root
+            self.pivots.append((old, new_root))
+        default_registry.counter("sync/pivots").inc()
+        self._note("sync/pivot", old=old.hex()[:12], new=new_root.hex()[:12],
+                   carried_leaves=carried)
 
     # --- main account trie ------------------------------------------------
 
@@ -338,9 +506,19 @@ class StateSyncer:
         def un_account_leaf(key_hash: bytes, batch) -> None:
             batch.delete(account_snapshot_key(key_hash))
 
+        with self.lock:
+            # re-runnable after a pivot or self-heal: task state is
+            # re-derived from the (replayed) account leaves every run
+            self.storage_tasks = []
+            self.phase = "accounts"
+        self._note("sync/phase", phase="accounts", root=self.root.hex()[:12])
         self._sync_trie(self.root, on_account_leaf,
                         on_unleaf=un_account_leaf)
 
+        with self.lock:
+            self.phase = "storage"
+        self._note("sync/phase", phase="storage",
+                   tasks=len(self.storage_tasks))
         # storage tries (deduped by root — identical contracts share; owner
         # sets dedupe the rebuild pass's on_leaf replay)
         futures = []
@@ -349,13 +527,45 @@ class StateSyncer:
             seen_roots.setdefault(storage_root, set()).add(account_hash)
         for storage_root, owners in seen_roots.items():
             futures.append(
-                self.pool.submit(
+                self._workers().submit(
                     self._sync_storage_trie, storage_root, sorted(owners))
             )
         for f in futures:
             f.result()
 
+        with self.lock:
+            self.phase = "code"
+        self._note("sync/phase", phase="code", hashes=len(self.code_hashes))
         self._sync_code()
+        with self.lock:
+            self.phase = "done"
+        self._note("sync/phase", phase="done")
+
+    def status(self) -> dict:
+        """Progress snapshot for the debug_syncStatus RPC."""
+        seg_starts = _segment_bounds(NUM_SEGMENTS)
+        segments = {}
+        for s in seg_starts:
+            m = self.diskdb.get(sync_segment_key(self.root, s))
+            if m == _SEG_DONE:
+                segments[s.hex()[:8]] = "done"
+            elif m is None:
+                segments[s.hex()[:8]] = "virgin"
+            else:
+                segments[s.hex()[:8]] = "at:" + m[1:].hex()[:16]
+        with self.lock:
+            return {
+                "root": self.root.hex(),
+                "phase": self.phase,
+                "segments": segments,
+                "storageTasks": len(self.storage_tasks),
+                "storageSynced": len(self.synced_storage_roots),
+                "codeHashes": len(self.code_hashes),
+                "pivots": [
+                    {"from": o.hex()[:12], "to": n.hex()[:12]}
+                    for o, n in self.pivots
+                ],
+            }
 
     def _sync_storage_trie(self, storage_root: bytes, owners: List[bytes]) -> None:
         def on_storage_leaf(slot_hash: bytes, value: bytes, batch) -> None:
@@ -368,7 +578,8 @@ class StateSyncer:
 
         self._sync_trie(storage_root, on_storage_leaf, account=owners[0],
                         on_unleaf=un_storage_leaf)
-        self.synced_storage_roots.add(storage_root)
+        with self.lock:
+            self.synced_storage_roots.add(storage_root)
 
     # --- code -------------------------------------------------------------
 

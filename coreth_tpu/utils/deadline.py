@@ -22,7 +22,8 @@ import threading
 import time
 from typing import Optional
 
-__all__ = ["Deadline", "DeadlineExceeded", "check", "current", "scope"]
+__all__ = ["Deadline", "DeadlineExceeded", "check", "current", "remaining",
+           "scope"]
 
 
 class DeadlineExceeded(Exception):
@@ -66,6 +67,17 @@ def check() -> None:
     d = getattr(_tls, "deadline", None)
     if d is not None:
         d.check()
+
+
+def remaining(default: float) -> float:
+    """Budget left for one sub-operation: [default] when no deadline is
+    armed on this thread, otherwise the armed deadline's remaining time
+    clamped to [0, default] — so a per-request-class timeout never
+    outlives the caller's overall budget."""
+    d = getattr(_tls, "deadline", None)
+    if d is None:
+        return default
+    return max(0.0, min(default, d.remaining()))
 
 
 class scope:

@@ -598,6 +598,18 @@ class DebugMetricsAPI:
             return {"pooled": False}
         return server.serving_status()
 
+    def syncStatus(self) -> dict:
+        """debug_syncStatus: bootstrap progress — peers by ladder state
+        (healthy/suspect/quarantined with scores and failure kinds),
+        per-segment trie progress, and the pivot history (ROBUSTNESS.md
+        "Bootstrap under Byzantine peers")."""
+        sync_client = getattr(self.vm, "state_sync_client", None)
+        if sync_client is None:
+            return {"syncing": False}
+        out = sync_client.status()
+        out["syncing"] = True
+        return out
+
 
 class DebugCommitmentAPI:
     """Commitment-backend surface of the debug namespace (COMMITMENT.md):

@@ -188,6 +188,32 @@ class Config:
     state_sync_commit_interval: int = 16384
     state_sync_min_blocks: int = 300_000
 
+    # --- sync robustness (ROBUSTNESS.md: bootstrap under Byzantine peers) -
+    # peer rotation attempts per logical request
+    sync_max_attempts: int = 32
+    # capped-exponential backoff between attempts (seconds)
+    sync_backoff_base: float = 0.02
+    sync_backoff_cap: float = 1.0
+    # per-request-class deadlines (seconds); each is additionally capped
+    # by any ambient utils/deadline budget on the calling thread
+    sync_leafs_deadline: float = 10.0
+    sync_blocks_deadline: float = 10.0
+    sync_code_deadline: float = 10.0
+    # hedged duplicate leafs requests: after hedge-delay seconds without
+    # an answer, the next-best peer races the primary (tail latency)
+    sync_hedge_requests: bool = False
+    sync_hedge_delay: float = 0.25
+    # distinct don't-have peers before a root is presumed stale and the
+    # sync pivots (clamped down to the connected-peer count)
+    sync_stale_root_votes: int = 3
+    # peer ladder: cumulative failure score that turns a peer suspect /
+    # quarantined, the base quarantine window (doubles per strike), and
+    # consecutive probe passes that re-admit a quarantined peer
+    sync_suspect_score: float = 4.0
+    sync_quarantine_score: float = 8.0
+    sync_quarantine_seconds: float = 30.0
+    sync_readmit_probes: int = 2
+
     # --- misc -------------------------------------------------------------
     max_outbound_active_requests: int = 16
     max_outbound_active_cross_chain_requests: int = 64
@@ -293,6 +319,29 @@ class Config:
                 raise ValueError(
                     f"{knob.replace('_', '-')} must be >= 1 "
                     f"(got {getattr(self, knob)})")
+        for knob in ("sync_backoff_base", "sync_backoff_cap",
+                     "sync_leafs_deadline", "sync_blocks_deadline",
+                     "sync_code_deadline", "sync_hedge_delay",
+                     "sync_quarantine_seconds"):
+            if getattr(self, knob) < 0:
+                raise ValueError(
+                    f"{knob.replace('_', '-')} must be >= 0 "
+                    f"(got {getattr(self, knob)})")
+        for knob in ("sync_max_attempts", "sync_stale_root_votes",
+                     "sync_readmit_probes"):
+            if getattr(self, knob) < 1:
+                raise ValueError(
+                    f"{knob.replace('_', '-')} must be >= 1 "
+                    f"(got {getattr(self, knob)})")
+        if self.sync_backoff_cap < self.sync_backoff_base:
+            raise ValueError(
+                f"sync-backoff-cap ({self.sync_backoff_cap}) must be >= "
+                f"sync-backoff-base ({self.sync_backoff_base})")
+        if not (0 < self.sync_suspect_score <= self.sync_quarantine_score):
+            raise ValueError(
+                f"need 0 < sync-suspect-score <= sync-quarantine-score "
+                f"(got {self.sync_suspect_score} / "
+                f"{self.sync_quarantine_score})")
         if self.resident_account_trie is True and not self.pruning_enabled:
             raise ValueError(
                 "resident-account-trie requires pruning: interval "

@@ -10,19 +10,24 @@ blockchain.go:2051 ResetToStateSyncedBlock)."""
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from ..core import rawdb
 from ..core.types import Block as EthBlock
-from ..sync.client import SyncClient
+from ..fault import Backoff
+from ..metrics import count_drop
+from ..sync.client import RootUnavailableError, SyncClient
 from ..sync.messages import SyncSummary
-from ..sync.statesync import StateSyncer
+from ..sync.statesync import StateSyncer, StateSyncError
 
 PARENTS_TO_FETCH = 256  # syncervm_client.go:237 parentsToGet
 SYNCABLE_INTERVAL = 16384  # state sync summary cadence (sync README)
 
 # resume marker (syncervm_client.go:111-140 summary persistence)
 SYNC_SUMMARY_KEY = b"stateSyncSummary"
+
+MAX_PIVOTS = 4       # re-targets before the sync gives up
+MAX_SELF_HEALS = 3   # rebuild-mismatch resets before the sync gives up
 
 
 class StateSyncServer:
@@ -52,11 +57,46 @@ class StateSyncServer:
 
 
 class StateSyncClient:
-    """stateSyncerClient orchestration (syncervm_client.go:148-330)."""
+    """stateSyncerClient orchestration (syncervm_client.go:148-330).
 
-    def __init__(self, vm, client: SyncClient):
+    [summary_provider] supplies the freshest syncable summary on demand
+    (typically a closure over the peer set); when the in-flight root
+    goes stale (RootUnavailableError), the sync PIVOTS to it instead of
+    failing — segment markers and buffered leaves carry forward."""
+
+    def __init__(self, vm, client: SyncClient,
+                 summary_provider: Optional[Callable[[], Optional[SyncSummary]]] = None,
+                 max_pivots: int = MAX_PIVOTS):
         self.vm = vm
         self.client = client
+        self.summary_provider = summary_provider
+        self.max_pivots = max_pivots
+        self.state_syncer: Optional[StateSyncer] = None
+        self.pivot_history: List[dict] = []
+        # the debug_syncStatus RPC finds us through the VM
+        vm.state_sync_client = self
+
+    def _flight_note(self):
+        chain = getattr(self.vm, "blockchain", None)
+        rec = getattr(chain, "flight_recorder", None)
+        return rec.note_event if rec is not None else None
+
+    def status(self) -> dict:
+        """debug_syncStatus payload: peers by ladder state, segment
+        progress, pivot history."""
+        network = getattr(self.client, "network", None)
+        peers = network.tracker.status() if network is not None else {}
+        by_state: dict = {}
+        for info in peers.values():
+            by_state[info["state"]] = by_state.get(info["state"], 0) + 1
+        out = {
+            "peers": peers,
+            "peersByState": by_state,
+            "pivots": list(self.pivot_history),
+        }
+        if self.state_syncer is not None:
+            out["trie"] = self.state_syncer.status()
+        return out
 
     def accept_summary(self, summary: SyncSummary) -> None:
         """acceptSyncSummary (:164): persist for resume, then run the sync
@@ -93,10 +133,73 @@ class StateSyncClient:
         return SyncSummary.decode(blob) if blob else None
 
     def state_sync(self, summary: SyncSummary) -> None:
-        self._sync_blocks(summary)
-        self._sync_state_trie(summary)
+        summary = self._sync_until_complete(summary)
         self._sync_atomic_trie(summary)
         self._finish(summary)
+
+    def _sync_until_complete(self, summary: SyncSummary) -> SyncSummary:
+        """Blocks + state trie with pivot/self-heal orchestration; returns
+        the summary the sync actually completed at (it moves on pivot)."""
+        diskdb = self.vm.blockchain.diskdb
+        syncer = self._make_syncer(summary.block_root)
+        self.state_syncer = syncer
+        backoff = Backoff(base=0.05, cap=2.0)
+        pivots = heals = 0
+        fetch_blocks = True
+        try:
+            while True:
+                if fetch_blocks:
+                    self._sync_blocks(summary)
+                    fetch_blocks = False
+                try:
+                    syncer.sync()
+                    return summary
+                except RootUnavailableError:
+                    newer = self._next_summary(summary)
+                    if newer is None or pivots >= self.max_pivots:
+                        raise
+                    pivots += 1
+                    syncer.pivot(newer.block_root)
+                    # the resume marker must follow the pivot: a crash
+                    # after this point resumes against the NEW summary,
+                    # whose markers/buffer the pivot just carried over
+                    diskdb.put(SYNC_SUMMARY_KEY, newer.encode())
+                    self.pivot_history.append({
+                        "fromHeight": summary.block_number,
+                        "toHeight": newer.block_number,
+                        "toRoot": newer.block_root.hex()[:16],
+                    })
+                    summary = newer
+                    fetch_blocks = True
+                except StateSyncError:
+                    # rebuild mismatch reset its own segment state; a
+                    # bounded retry against (now re-ranked) peers heals it
+                    heals += 1
+                    if heals > MAX_SELF_HEALS:
+                        raise
+                    backoff.sleep()
+        finally:
+            syncer.close()  # the pre-fix executor leak
+
+    def _make_syncer(self, root: bytes) -> StateSyncer:
+        return StateSyncer(
+            self.client, self.vm.blockchain.diskdb, root,
+            note_event=self._flight_note(),
+        )
+
+    def _next_summary(self, current: SyncSummary) -> Optional[SyncSummary]:
+        """A STRICTLY newer summary from the provider, or None."""
+        if self.summary_provider is None:
+            return None
+        try:
+            cand = self.summary_provider()
+        except Exception:
+            count_drop("sync/drops/summary_provider_error")
+            return None
+        if (cand is None or cand.block_number <= current.block_number
+                or cand.block_root == current.block_root):
+            return None
+        return cand
 
     def _sync_atomic_trie(self, summary: SyncSummary) -> None:
         """syncAtomicTrie (:284): rebuild the indexed atomic ops and replay
@@ -141,10 +244,14 @@ class StateSyncClient:
             rawdb.write_canonical_hash(diskdb, h, n)
 
     def _sync_state_trie(self, summary: SyncSummary) -> None:
-        syncer = StateSyncer(
-            self.client, self.vm.blockchain.diskdb, summary.block_root
-        )
-        syncer.sync()
+        """Single-shot trie sync (no pivot orchestration) — kept for
+        callers that manage their own retry policy."""
+        syncer = self._make_syncer(summary.block_root)
+        self.state_syncer = syncer
+        try:
+            syncer.sync()
+        finally:
+            syncer.close()
 
     def _finish(self, summary: SyncSummary) -> None:
         """ResetToStateSyncedBlock (blockchain.go:2051): move chain pointers

@@ -1,0 +1,862 @@
+"""Adversarial-resilient state sync (ROBUSTNESS.md "Bootstrap under
+Byzantine peers"): the peer scoring ladder, disciplined retries
+(backoff + per-class deadlines + hedging), don't-have quorum → dynamic
+pivot, crash-resumable bootstrap under SIGKILL, and the seeded
+majority-malicious end-to-end drill.
+
+Reference shapes: peer/peer_tracker.go bandwidth tracking,
+sync/client/client.go:293-361 retry-with-rotation, and
+plugin/evm/syncervm_client.go orchestration."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from coreth_tpu import fault
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.metrics import default_registry
+from coreth_tpu.native import keccak256
+from coreth_tpu.peer.network import (
+    FAIL_DEADLINE,
+    FAIL_DECODE,
+    FAIL_PROOF,
+    FAIL_TRANSPORT,
+    PEER_HEALTHY,
+    PEER_QUARANTINED,
+    PEER_SUSPECT,
+    Network,
+    PeerTracker,
+)
+from coreth_tpu.peer.testing import AdversarialPeer, FaultyTransport
+from coreth_tpu.state.database import Database
+from coreth_tpu.state.snapshot import SNAPSHOT_ACCOUNT_PREFIX
+from coreth_tpu.state.statedb import StateDB
+from coreth_tpu.sync.client import (
+    ClientError,
+    RootUnavailableError,
+    SyncClient,
+)
+from coreth_tpu.sync.handlers import SyncHandler
+from coreth_tpu.sync.statesync import (
+    NUM_SEGMENTS,
+    SYNC_LEAF_PREFIX,
+    SYNC_SEGMENT_PREFIX,
+    StateSyncer,
+    StateSyncError,
+)
+from coreth_tpu.trie.node import EMPTY_ROOT
+from coreth_tpu.trie.triedb import TrieDatabase
+from coreth_tpu.utils import deadline as deadline_mod
+
+from test_sync_segments import (
+    N_BIG,
+    CountingClient,
+    _LeafsOnlyHandler,
+    build_server_state,
+    make_client,
+)
+
+
+def C(name):
+    return default_registry.counter(name).count()
+
+
+# ---------------------------------------------------------------------------
+# Peer scoring ladder (unit)
+# ---------------------------------------------------------------------------
+
+
+class TestPeerLadder:
+    def test_proof_failures_weigh_hardest(self):
+        tr = PeerTracker()
+        tr.record_failure(b"slow", FAIL_TRANSPORT)   # weight 1
+        tr.record_failure(b"liar", FAIL_PROOF)       # weight 4 -> suspect
+        assert tr.peers[b"slow"].state == PEER_HEALTHY
+        assert tr.peers[b"liar"].state == PEER_SUSPECT
+        tr.record_failure(b"liar", FAIL_PROOF)       # 8 -> quarantined
+        assert tr.peers[b"liar"].state == PEER_QUARANTINED
+        assert tr.peers[b"liar"].quarantine_until > time.monotonic()
+        assert tr.peers[b"liar"].fail_kinds == {FAIL_PROOF: 2}
+
+    def test_success_decays_score_and_demotes_suspect(self):
+        tr = PeerTracker()
+        tr.record_failure(b"a", FAIL_PROOF)
+        assert tr.peers[b"a"].state == PEER_SUSPECT
+        tr.record_success(b"a", 1000, 0.01)
+        assert tr.peers[b"a"].score == 2.0  # halved
+        assert tr.peers[b"a"].state == PEER_HEALTHY
+
+    def test_quarantine_window_escalates_per_strike(self):
+        tr = PeerTracker()
+        tr.configure(quarantine_seconds=10.0)
+        tr.record_failure(b"q", FAIL_PROOF)
+        tr.record_failure(b"q", FAIL_PROOF)
+        st = tr.peers[b"q"]
+        first = st.quarantine_until - time.monotonic()
+        assert 5.0 < first <= 10.5  # strike 0 span
+        # force the window to expire, then fail the probe: the span doubles
+        st.quarantine_until = time.monotonic() - 1.0
+        before = C("peer/ladder/probe_failures")
+        tr.record_failure(b"q", FAIL_PROOF)
+        second = st.quarantine_until - time.monotonic()
+        assert second > first * 2
+        assert C("peer/ladder/probe_failures") == before + 1
+
+    def test_probe_readmission_after_consecutive_passes(self):
+        tr = PeerTracker()
+        tr.configure(readmit_probes=2)
+        tr.record_failure(b"q", FAIL_PROOF)
+        tr.record_failure(b"q", FAIL_PROOF)
+        st = tr.peers[b"q"]
+        assert st.state == PEER_QUARANTINED
+        st.quarantine_until = time.monotonic() - 1.0  # probe window open
+        before = C("peer/ladder/readmissions")
+        tr.record_success(b"q", 500, 0.01)
+        assert st.state == PEER_QUARANTINED  # one pass is not enough
+        tr.record_success(b"q", 500, 0.01)
+        assert st.state == PEER_SUSPECT      # re-admitted on probation
+        assert st.score == tr.suspect_score / 2.0
+        assert C("peer/ladder/readmissions") == before + 1
+        tr.record_success(b"q", 500, 0.01)   # decays below the bar
+        assert st.state == PEER_HEALTHY
+
+    def test_probe_failure_resets_passes(self):
+        tr = PeerTracker()
+        tr.record_failure(b"q", FAIL_PROOF)
+        tr.record_failure(b"q", FAIL_PROOF)
+        st = tr.peers[b"q"]
+        st.quarantine_until = time.monotonic() - 1.0
+        tr.record_success(b"q", 500, 0.01)  # pass 1 of 2
+        assert st.probe_passes == 1
+        tr.record_failure(b"q", FAIL_TRANSPORT)
+        assert st.probe_passes == 0
+        assert st.state == PEER_QUARANTINED
+
+    def test_best_peer_tiers_untested_healthy_suspect_quarantined(self):
+        tr = PeerTracker()
+        tr.record_success(b"h", 10_000, 0.1)                  # healthy
+        tr.record_success(b"s", 99_999, 0.1)
+        tr.record_failure(b"s", FAIL_PROOF)                   # suspect
+        tr.record_failure(b"q", FAIL_PROOF)
+        tr.record_failure(b"q", FAIL_PROOF)                   # quarantined
+        tr.connected(b"u")                                    # untested
+        assert tr.best_peer() == b"u"
+        assert tr.best_peer(exclude={b"u"}) == b"h"
+        assert tr.best_peer(exclude={b"u", b"h"}) == b"s"
+        # an all-quarantined rotation degrades to probing, never deadlocks
+        assert tr.best_peer(exclude={b"u", b"h", b"s"}) == b"q"
+        assert tr.best_peer(exclude={b"u", b"h", b"s", b"q"}) is None
+
+    def test_expired_quarantine_outranks_active_quarantine(self):
+        tr = PeerTracker()
+        for nid in (b"done", b"active"):
+            tr.record_failure(nid, FAIL_PROOF)
+            tr.record_failure(nid, FAIL_PROOF)
+        tr.peers[b"done"].quarantine_until = time.monotonic() - 1.0
+        assert tr.best_peer() == b"done"  # probe window beats active ban
+
+    def test_rank_discounts_failure_rate(self):
+        tr = PeerTracker()
+        tr.record_success(b"clean", 1000, 0.1)
+        tr.record_success(b"flaky", 1000, 0.1)
+        tr.record_failure(b"flaky", FAIL_TRANSPORT)
+        assert tr.peers[b"flaky"].state == PEER_HEALTHY  # same tier
+        assert tr.best_peer() == b"clean"
+
+    def test_status_snapshot_shape(self):
+        tr = PeerTracker()
+        tr.record_failure(b"\x01" * 4, FAIL_DEADLINE)
+        snap = tr.status()
+        info = snap[(b"\x01" * 4).hex()]
+        assert info["state"] == PEER_HEALTHY
+        assert info["failKinds"] == {FAIL_DEADLINE: 1}
+        assert info["bandwidth"] == 0.0  # tested, never a good transfer
+
+
+# ---------------------------------------------------------------------------
+# Gossip: a hung peer must not stall the fan-out (satellite fix)
+# ---------------------------------------------------------------------------
+
+
+class TestGossipTimeouts:
+    def test_gossip_does_not_block_on_hung_peer(self):
+        net = Network()
+        net.gossip_deadline = 0.3
+        hang = threading.Event()
+        got = []
+        net.connect(b"hung", lambda s, r: hang.wait(30) or b"")
+        net.connect(b"fast", lambda s, r: got.append(r) or b"")
+        before = C("peer/gossip_timeouts")
+        t0 = time.monotonic()
+        net.gossip(b"payload")
+        assert time.monotonic() - t0 < 5  # unblocked at the deadline
+        assert got == [b"\xff" + b"payload"]  # healthy peer still served
+        assert C("peer/gossip_timeouts") == before + 1
+        hang.set()
+
+
+# ---------------------------------------------------------------------------
+# Disciplined retries: backoff, deadlines, hedging, typed scoring
+# ---------------------------------------------------------------------------
+
+
+class TestDisciplinedRetries:
+    def test_retries_are_counted_and_typed(self):
+        tdb, root = build_server_state(50)
+        handler = _LeafsOnlyHandler(tdb)
+        net = Network(self_id=b"client")
+        ft = FaultyTransport(lambda s, r: handler.handle(s, r),
+                             ["drop", "empty", "ok"])
+        net.connect(b"p", ft)
+        client = SyncClient(net, backoff_base=0.001, backoff_cap=0.01)
+        before_r = C("sync/retries")
+        before_d = C("sync/failures/decode")
+        resp = client.get_leafs(root, limit=10)
+        assert len(resp.keys) == 10
+        assert C("sync/retries") >= before_r + 2
+        assert C("sync/failures/decode") == before_d + 1  # the b"" response
+        st = net.tracker.peers[b"p"]
+        assert st.fail_kinds.get(FAIL_TRANSPORT) == 1
+        assert st.fail_kinds.get(FAIL_DECODE) == 1
+
+    def test_ambient_deadline_caps_request_class_budget(self):
+        assert deadline_mod.remaining(5.0) == 5.0  # nothing armed
+        with deadline_mod.scope(deadline_mod.Deadline(0.2)):
+            assert deadline_mod.remaining(5.0) <= 0.2
+            assert deadline_mod.remaining(0.05) <= 0.05
+        assert deadline_mod.remaining(5.0) == 5.0
+
+    def test_expired_ambient_deadline_aborts_retry_loop(self):
+        tdb, root = build_server_state(20)
+        client = make_client(tdb)
+        with deadline_mod.scope(deadline_mod.Deadline(-0.01)):
+            with pytest.raises(deadline_mod.DeadlineExceeded):
+                client.get_leafs(root, limit=5)
+
+    def test_hedged_request_races_next_best_peer(self):
+        tdb, root = build_server_state(50)
+        handler = _LeafsOnlyHandler(tdb)
+        slow_gate = threading.Event()
+
+        def slow(sender, req):
+            slow_gate.wait(5)
+            return handler.handle(sender, req)
+
+        net = Network(self_id=b"client")
+        net.connect(b"slow", slow)  # first-connected: picked as primary
+        net.connect(b"fast", lambda s, r: handler.handle(s, r))
+        client = SyncClient(net, hedge_enabled=True, hedge_delay=0.05)
+        before_h, before_w = C("sync/hedges"), C("sync/hedge_wins")
+        t0 = time.monotonic()
+        resp = client.get_leafs(root, limit=10)
+        elapsed = time.monotonic() - t0
+        slow_gate.set()
+        assert len(resp.keys) == 10
+        assert elapsed < 3  # did not wait out the slow primary
+        assert C("sync/hedges") == before_h + 1
+        assert C("sync/hedge_wins") == before_w + 1
+        client.close()
+
+
+# ---------------------------------------------------------------------------
+# GetBlocks validation (satellite fix: empty/short responses)
+# ---------------------------------------------------------------------------
+
+
+class TestGetBlocksValidation:
+    def _server(self):
+        from test_sync import build_server_vm
+
+        server, _ = build_server_vm(n_blocks=8)
+        handler = SyncHandler(server.blockchain,
+                              server.state_database.triedb,
+                              server.blockchain.diskdb)
+        return server, handler
+
+    def test_empty_block_response_is_never_success(self):
+        server, handler = self._server()
+        net = Network(self_id=b"client")
+        net.connect(b"empty",
+                    AdversarialPeer(lambda s, r: handler.handle(s, r),
+                                    "empty"))
+        client = SyncClient(net, max_attempts=3, backoff_base=0.001,
+                            backoff_cap=0.002)
+        tip = server.blockchain.last_accepted
+        with pytest.raises(ClientError, match="exhausted"):
+            client.get_blocks(tip.hash(), tip.number, 5)
+        server.shutdown()
+
+    def test_short_block_response_rejected_unless_genesis(self):
+        from coreth_tpu.sync.messages import BlockResponse, decode_message
+
+        server, handler = self._server()
+
+        def trunc(sender, req):
+            raw = handler.handle(sender, req)
+            msg = decode_message(raw)
+            if isinstance(msg, BlockResponse) and len(msg.blocks) > 2:
+                msg.blocks = msg.blocks[:2]
+                return msg.encode()
+            return raw
+
+        net = Network(self_id=b"client")
+        net.connect(b"short", trunc)
+        client = SyncClient(net, max_attempts=3, backoff_base=0.001,
+                            backoff_cap=0.002)
+        tip = server.blockchain.last_accepted
+        # 2 of 5 parents without bottoming out at genesis: a scored failure
+        with pytest.raises(ClientError, match="exhausted"):
+            client.get_blocks(tip.hash(), tip.number, 5)
+        assert net.tracker.peers[b"short"].fail_kinds.get(FAIL_PROOF, 0) >= 1
+        server.shutdown()
+
+    def test_short_response_reaching_genesis_is_accepted(self):
+        server, handler = self._server()
+        net = Network(self_id=b"client")
+        net.connect(b"honest", lambda s, r: handler.handle(s, r))
+        client = SyncClient(net)
+        tip = server.blockchain.last_accepted
+        blobs = client.get_blocks(tip.hash(), tip.number, 20)
+        from coreth_tpu.core.types import Block
+
+        assert len(blobs) == 9  # blocks 8..0: genesis bottoms out the walk
+        assert Block.decode(blobs[-1]).number == 0
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Don't-have quorum and the stale-root escape hatch
+# ---------------------------------------------------------------------------
+
+
+class TestDontHaveQuorum:
+    def _wire(self, modes):
+        tdb, root = build_server_state(50)
+        handler = _LeafsOnlyHandler(tdb)
+        net = Network(self_id=b"client")
+        for i, mode in enumerate(modes):
+            net.connect(b"p%d" % i,
+                        AdversarialPeer(lambda s, r: handler.handle(s, r),
+                                        mode))
+        return net, root
+
+    def test_quorum_of_dont_have_raises_root_unavailable(self):
+        net, root = self._wire(["empty", "empty", "empty"])
+        client = SyncClient(net, stale_root_votes=3, backoff_base=0.001,
+                            backoff_cap=0.002)
+        before = C("sync/root_unavailable_votes")
+        with pytest.raises(RootUnavailableError) as ei:
+            client.get_leafs(root, limit=10)
+        assert len(ei.value.peers) == 3  # distinct voters, not retries
+        assert C("sync/root_unavailable_votes") == before + 3
+
+    def test_one_honest_peer_defeats_empty_voters(self):
+        net, root = self._wire(["empty", "empty", "honest"])
+        client = SyncClient(net, stale_root_votes=3, backoff_base=0.001,
+                            backoff_cap=0.002)
+        resp = client.get_leafs(root, limit=10)
+        assert len(resp.keys) == 10  # rotation found the truth first
+
+
+# ---------------------------------------------------------------------------
+# Failpoints (chaos hooks)
+# ---------------------------------------------------------------------------
+
+
+class TestSyncFailpoints:
+    def test_sync_failpoints_are_registered(self):
+        reg = fault.registered()
+        for name in ("sync/before_request", "sync/before_pivot",
+                     "sync/before_rebuild"):
+            assert name in reg
+
+    def test_before_request_raise_budget(self):
+        tdb, root = build_server_state(20)
+        client = make_client(tdb)
+        fault.set_failpoint("sync/before_request", "raise*2")
+        for _ in range(2):
+            with pytest.raises(fault.FailpointError):
+                client.get_leafs(root, limit=5)
+        resp = client.get_leafs(root, limit=5)  # budget spent: healthy again
+        assert len(resp.keys) == 5
+
+    def test_before_pivot_fires_before_any_marker_moves(self):
+        tdb, root = build_server_state(20)
+        client_db = MemoryDB()
+        syncer = StateSyncer(make_client(tdb), client_db, root)
+        fault.set_failpoint("sync/before_pivot", "raise*1")
+        with pytest.raises(fault.FailpointError):
+            syncer.pivot(b"\x42" * 32)
+        assert syncer.root == root          # nothing re-targeted
+        assert syncer.pivots == []
+        fault.clear_all()
+        syncer.pivot(b"\x42" * 32)          # disarmed: pivot proceeds
+        assert syncer.root == b"\x42" * 32
+
+
+# ---------------------------------------------------------------------------
+# Lying-peer rollback: phantom snapshot entries cannot survive (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestLyingPeerRollback:
+    def test_truncating_peer_rolls_back_phantom_snapshot_entries(self):
+        tdb, root = build_server_state(N_BIG)
+        handler = _LeafsOnlyHandler(tdb)
+        client_db = MemoryDB()
+        net = Network(self_id=b"client")
+        net.connect(b"liar",
+                    AdversarialPeer(lambda s, r: handler.handle(s, r),
+                                    "truncated_stream"))
+        client = SyncClient(net, backoff_base=0.001, backoff_cap=0.01)
+        syncer = StateSyncer(client, client_db, root)
+        before = C("sync/rebuild_mismatch")
+        with pytest.raises(StateSyncError, match="rebuild root mismatch"):
+            syncer.sync()
+        syncer.close()
+        assert C("sync/rebuild_mismatch") == before + 1
+        # segment state reset for refetch, buffer gone, and — the
+        # satellite's point — the on_unleaf rollback removed every
+        # snapshot entry the unverified leaves wrote
+        assert not list(client_db.iterate(SYNC_SEGMENT_PREFIX))
+        assert not list(client_db.iterate(SYNC_LEAF_PREFIX))
+        phantoms = [k for k, _ in client_db.iterate(SNAPSHOT_ACCOUNT_PREFIX)
+                    if len(k) == 33]
+        assert not phantoms
+
+        # the standard self-heal: an honest peer completes the same db
+        healer = StateSyncer(make_client(tdb), client_db, root)
+        healer.sync()
+        healer.close()
+        assert client_db.get(root) is not None
+        snapshot_rows = [k for k, _ in
+                         client_db.iterate(SNAPSHOT_ACCOUNT_PREFIX)
+                         if len(k) == 33]
+        assert len(snapshot_rows) == N_BIG
+
+
+# ---------------------------------------------------------------------------
+# Config knobs (satellite: validated sync-* configuration)
+# ---------------------------------------------------------------------------
+
+
+class TestSyncConfigKnobs:
+    def test_defaults_validate(self):
+        from coreth_tpu.vm.config import parse_config
+
+        parse_config(b"{}").validate()
+
+    def test_kebab_case_keys_map(self):
+        from coreth_tpu.vm.config import parse_config
+
+        cfg = parse_config(json.dumps({
+            "sync-hedge-requests": True,
+            "sync-backoff-base": 0.5,
+            "sync-backoff-cap": 2.0,
+            "sync-quarantine-score": 12.0,
+        }))
+        cfg.validate()
+        assert cfg.sync_hedge_requests is True
+        assert cfg.sync_backoff_base == 0.5
+        assert cfg.sync_quarantine_score == 12.0
+
+    @pytest.mark.parametrize("blob", [
+        {"sync-max-attempts": 0},
+        {"sync-backoff-base": -0.1},
+        {"sync-backoff-base": 1.0, "sync-backoff-cap": 0.5},
+        {"sync-leafs-deadline": -1.0},
+        {"sync-hedge-delay": -0.5},
+        {"sync-stale-root-votes": 0},
+        {"sync-readmit-probes": 0},
+        {"sync-quarantine-seconds": -1.0},
+        {"sync-suspect-score": 0.0},
+        {"sync-suspect-score": 9.0, "sync-quarantine-score": 8.0},
+    ])
+    def test_bad_knobs_rejected(self, blob):
+        from coreth_tpu.vm.config import parse_config
+
+        with pytest.raises(ValueError):
+            parse_config(json.dumps(blob)).validate()
+
+    def test_from_config_wires_client_and_ladder(self):
+        from coreth_tpu.vm.config import parse_config
+
+        cfg = parse_config(json.dumps({
+            "sync-max-attempts": 7,
+            "sync-leafs-deadline": 3.5,
+            "sync-hedge-requests": True,
+            "sync-hedge-delay": 0.1,
+            "sync-stale-root-votes": 2,
+            "sync-suspect-score": 3.0,
+            "sync-quarantine-score": 6.0,
+            "sync-quarantine-seconds": 12.0,
+            "sync-readmit-probes": 4,
+        }))
+        cfg.validate()
+        net = Network()
+        client = SyncClient.from_config(net, cfg)
+        assert client.max_attempts == 7
+        assert client.deadlines["leafs"] == 3.5
+        assert client.hedge_enabled and client.hedge_delay == 0.1
+        assert client.stale_root_votes == 2
+        assert net.tracker.suspect_score == 3.0
+        assert net.tracker.quarantine_score == 6.0
+        assert net.tracker.quarantine_seconds == 12.0
+        assert net.tracker.readmit_probes == 4
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL drills: markered progress survives a real process kill
+# ---------------------------------------------------------------------------
+
+# The child builds the same deterministic server state as
+# build_server_state(n), syncs it into a SQLite db with a small leaf
+# limit, and parks every request after [park_after] — either on the
+# sync/before_request `hang` failpoint (mode=failpoint) or on a plain
+# event (mode=event). Arming happens under the call-counter lock, so
+# exactly [park_after] requests complete: the on-disk state at SIGKILL
+# is bit-deterministic (the segmented switch has seeded exactly
+# park_after * leaf_limit leaves + all segment markers).
+SYNC_KILL_CHILD = r"""
+import os, sys, threading
+sys.path.insert(0, sys.argv[2])
+from coreth_tpu import fault
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.ethdb.sqlitedb import SQLiteDB
+from coreth_tpu.peer.network import Network
+from coreth_tpu.state.database import Database
+from coreth_tpu.state.statedb import StateDB
+from coreth_tpu.sync.client import SyncClient
+from coreth_tpu.sync.handlers import LeafsRequestHandler
+from coreth_tpu.sync.messages import decode_message
+from coreth_tpu.sync.statesync import StateSyncer
+from coreth_tpu.trie.node import EMPTY_ROOT
+from coreth_tpu.trie.triedb import TrieDatabase
+
+db_path = sys.argv[1]
+n_accounts = int(sys.argv[3])
+park_after = int(sys.argv[4])
+leaf_limit = int(sys.argv[5])
+use_failpoint = sys.argv[6] == "failpoint"
+
+server_db = MemoryDB()
+tdb = TrieDatabase(server_db)
+st = StateDB(EMPTY_ROOT, Database(tdb))
+for i in range(1, n_accounts + 1):
+    st.add_balance(i.to_bytes(20, "big"), 10**15 + i)
+root = st.commit()
+tdb.commit(root)
+
+handler = LeafsRequestHandler(tdb)
+net = Network(self_id=b"client")
+net.connect(b"server",
+            lambda s, r: handler.on_leafs_request(decode_message(r)).encode())
+inner = SyncClient(net)
+park = threading.Event()
+
+class ParkingClient:
+    def __init__(self):
+        self.calls = 0
+        self.announced = False
+        self.lock = threading.Lock()
+
+    def get_leafs(self, *a, **kw):
+        with self.lock:
+            self.calls += 1
+            me = self.calls
+            if use_failpoint and me == park_after + 1:
+                # armed under the lock: every me > park_after caller sees it
+                fault.set_failpoint("sync/before_request", "hang")
+            announce = me > park_after and not self.announced
+            if announce:
+                self.announced = True
+        if announce:
+            # one writer, one atomic write: concurrent segment threads must
+            # not interleave the parent's kill signal
+            os.write(1, b"READY\n")
+        if me > park_after and not use_failpoint:
+            park.wait()  # parked until SIGKILL
+        return inner.get_leafs(*a, **kw)  # failpoint mode parks in here
+
+    def __getattr__(self, name):
+        return getattr(inner, name)
+
+client_db = SQLiteDB(db_path, sync=False)
+syncer = StateSyncer(ParkingClient(), client_db, root, leaf_limit=leaf_limit)
+syncer._sync_trie(root, lambda k, v, batch: None)
+print("DONE", flush=True)
+"""
+
+PARK_AFTER = 8
+KILL_LEAF_LIMIT = 256
+SEEDED = PARK_AFTER * KILL_LEAF_LIMIT  # == SEGMENT_THRESHOLD: switch point
+
+
+def _run_child_until_ready(path, mode):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", SYNC_KILL_CHILD, path, repo,
+         str(N_BIG), str(PARK_AFTER), str(KILL_LEAF_LIMIT), mode],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    lines, deadline = [], time.time() + 300
+    try:
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            lines.append(line.strip())
+            if "READY" in line:
+                break
+        assert any("READY" in ln for ln in lines), (
+            lines, proc.stderr.read()[-2000:])
+    finally:
+        proc.kill()  # SIGKILL: no atexit, no close, no flush
+        proc.wait(30)
+
+
+def _noop_leaf(key, value, batch):
+    pass
+
+
+class TestSigkillResume:
+    def test_sigkill_mid_segment_resumes_from_markers(self, tmp_path):
+        """ISSUE acceptance: SIGKILL mid-sync; the restart resumes from
+        the persisted segment markers and never refetches markered data
+        (here the park is the sync/before_request hang failpoint)."""
+        from coreth_tpu.ethdb.sqlitedb import SQLiteDB
+
+        path = str(tmp_path / "sync.db")
+        _run_child_until_ready(path, "failpoint")
+
+        client_db = SQLiteDB(path, sync=False)
+        tdb, root = build_server_state(N_BIG)  # same deterministic state
+        markers = list(client_db.iterate(SYNC_SEGMENT_PREFIX + root))
+        assert len(markers) == NUM_SEGMENTS  # seeded switch hit the disk
+        buffered = len(list(client_db.iterate(SYNC_LEAF_PREFIX + root)))
+        assert buffered == SEEDED
+
+        resuming = CountingClient(make_client(tdb))
+        syncer = StateSyncer(resuming, client_db, root,
+                             leaf_limit=KILL_LEAF_LIMIT)
+        count = syncer._sync_trie(root, _noop_leaf)
+        syncer.close()
+        assert count == N_BIG
+        # the markered (seeded) prefix was NOT refetched
+        assert resuming.leaves == N_BIG - SEEDED
+        assert client_db.get(root) is not None
+        assert not list(client_db.iterate(SYNC_SEGMENT_PREFIX))
+        assert not list(client_db.iterate(SYNC_LEAF_PREFIX))
+        client_db.close()
+
+    def test_sigkill_then_pivot_carries_markered_progress(self, tmp_path):
+        """ISSUE acceptance: SIGKILL mid-sync, then the restart PIVOTS to
+        a newer root — segment markers and the leaf buffer carry forward
+        and the markered prefix is still not refetched."""
+        from coreth_tpu.ethdb.sqlitedb import SQLiteDB
+
+        path = str(tmp_path / "pivot.db")
+        _run_child_until_ready(path, "event")
+
+        tdb1, root1 = build_server_state(N_BIG)
+        # the new summary differs in ONE account chosen so its trie key
+        # is the largest in the keyspace — provably outside the seeded
+        # (markered) prefix, so the carried buffer stays valid
+        hashes = {i: keccak256(i.to_bytes(20, "big"))
+                  for i in range(1, N_BIG + 1)}
+        bump = max(hashes, key=lambda i: hashes[i])
+        assert hashes[bump] > sorted(hashes.values())[SEEDED - 1]
+        server_db2 = MemoryDB()
+        tdb2 = TrieDatabase(server_db2)
+        st = StateDB(EMPTY_ROOT, Database(tdb2))
+        for i in range(1, N_BIG + 1):
+            st.add_balance(i.to_bytes(20, "big"), 10**15 + i)
+        st.add_balance(bump.to_bytes(20, "big"), 7)
+        root2 = st.commit()
+        tdb2.commit(root2)
+        assert root2 != root1
+
+        client_db = SQLiteDB(path, sync=False)
+        assert len(list(client_db.iterate(SYNC_SEGMENT_PREFIX + root1))) \
+            == NUM_SEGMENTS
+        assert len(list(client_db.iterate(SYNC_LEAF_PREFIX + root1))) \
+            == SEEDED
+
+        resuming = CountingClient(make_client(tdb2))
+        syncer = StateSyncer(resuming, client_db, root1,
+                             leaf_limit=KILL_LEAF_LIMIT)
+        before = C("sync/pivots")
+        syncer.pivot(root2)
+        assert C("sync/pivots") == before + 1
+        # markers + buffer moved under the new root, old root wiped
+        assert not list(client_db.iterate(SYNC_SEGMENT_PREFIX + root1))
+        assert not list(client_db.iterate(SYNC_LEAF_PREFIX + root1))
+        assert len(list(client_db.iterate(SYNC_SEGMENT_PREFIX + root2))) \
+            == NUM_SEGMENTS
+        assert len(list(client_db.iterate(SYNC_LEAF_PREFIX + root2))) \
+            == SEEDED
+
+        count = syncer._sync_trie(root2, _noop_leaf)
+        syncer.close()
+        assert count == N_BIG
+        assert resuming.leaves == N_BIG - SEEDED  # carried data not refetched
+        assert client_db.get(root2) is not None
+        assert syncer.pivots == [(root1, root2)]
+        assert syncer.status()["pivots"] == [
+            {"from": root1.hex()[:12], "to": root2.hex()[:12]}]
+        client_db.close()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end drills: majority-malicious bootstrap + stale-root pivot
+# ---------------------------------------------------------------------------
+
+
+class TestByzantineBootstrap:
+    def _client_vm(self, server):
+        from coreth_tpu.vm.shared_memory import Memory
+        from coreth_tpu.vm.vm import VM, SnowContext, VMConfig
+
+        vm = VM()
+        vm.initialize(SnowContext(shared_memory=Memory()), MemoryDB(),
+                      server.test_genesis, VMConfig())
+        return vm
+
+    def test_majority_malicious_bootstrap_converges_and_quarantines(self):
+        """ISSUE acceptance: misbehaving peers OUTNUMBER honest ones
+        (8 vs 2); the bootstrap still converges bit-exactly, every
+        misbehaving peer the ladder scored is quarantined, and
+        debug_syncStatus shows it all."""
+        from test_sync import DEST, build_server_vm
+        from coreth_tpu.core.genesis import GenesisAccount
+        from coreth_tpu.vm.api import DebugMetricsAPI
+        from coreth_tpu.vm.syncervm import StateSyncClient, StateSyncServer
+
+        extra = {i.to_bytes(20, "big"): GenesisAccount(balance=10**15 + i)
+                 for i in range(1, 2601)}  # large enough to segment
+        server, _ = build_server_vm(n_blocks=8, extra_alloc=extra)
+        summary = StateSyncServer(server.blockchain,
+                                  syncable_interval=4).get_last_state_summary()
+        handler = SyncHandler(server.blockchain,
+                              server.state_database.triedb,
+                              server.blockchain.diskdb)
+
+        def serve(s, r):
+            return handler.handle(s, r)
+
+        peers = {
+            b"honest-1": AdversarialPeer(serve, "honest"),
+            b"honest-2": AdversarialPeer(serve, "honest"),
+            b"liar-1": AdversarialPeer(serve, "lying_leafs"),
+            b"liar-2": AdversarialPeer(serve, "lying_leafs"),
+            b"badproof": AdversarialPeer(serve, "bad_proof"),
+            b"trunc-1": AdversarialPeer(serve, "truncated_stream"),
+            b"trunc-2": AdversarialPeer(serve, "truncated_stream"),
+            b"staller": AdversarialPeer(serve, "stall", stall_seconds=5.0),
+            b"garbage": AdversarialPeer(serve, "garbage"),
+            b"flapper": AdversarialPeer(serve, "flap"),
+        }
+        net = Network(self_id=b"client")
+        for nid, peer in peers.items():
+            net.connect(nid, peer)
+        # drill tuning: ONE scored failure of any kind quarantines, and
+        # the window outlives the test so nothing sneaks back in
+        net.tracker.configure(suspect_score=1.0, quarantine_score=1.0,
+                              quarantine_seconds=300.0)
+        client = SyncClient(
+            net, deadlines={"leafs": 2.0, "blocks": 2.0, "code": 2.0},
+            backoff_base=0.002, backoff_cap=0.02)
+
+        client_vm = self._client_vm(server)
+        StateSyncClient(client_vm, client).accept_summary(summary)
+
+        # bit-exact convergence despite the malicious majority
+        assert client_vm.blockchain.last_accepted.hash() == summary.block_hash
+        st = client_vm.blockchain.state()
+        assert st.get_balance(DEST) == 8 * 5 * 3
+        assert st.get_balance((2600).to_bytes(20, "big")) == 10**15 + 2600
+
+        status = DebugMetricsAPI(client_vm).syncStatus()
+        assert status["syncing"] is True
+        assert status["trie"]["phase"] == "done"
+        infos = status["peers"]
+        for name in (b"honest-1", b"honest-2"):
+            assert infos[name.hex()]["state"] == PEER_HEALTHY, name
+        # always-fail modes are deterministically caught and quarantined
+        for name in (b"staller", b"garbage", b"flapper"):
+            assert infos[name.hex()]["state"] == PEER_QUARANTINED, name
+        # every misbehaving peer the ladder scored is quarantined (a
+        # truncator whose lies were all neutralized by the proof-derived
+        # more-flag may legitimately end unscored)
+        quarantined = 0
+        for nid, peer in peers.items():
+            info = infos[nid.hex()]
+            if peer.mode != "honest" and info["failures"] > 0:
+                assert info["state"] == PEER_QUARANTINED, (nid, info)
+                quarantined += 1
+        assert quarantined >= 6
+        assert status["peersByState"][PEER_QUARANTINED] == quarantined
+        client_vm.shutdown()
+        server.shutdown()
+
+    def test_stale_root_pivots_to_newer_summary(self):
+        """Peers that pruned the requested root answer don't-have; the
+        quorum pivots the orchestration to the provider's newer summary
+        and the bootstrap completes there."""
+        from test_sync import build_server_vm
+        from coreth_tpu.sync.messages import (LeafsRequest, LeafsResponse,
+                                              decode_message)
+        from coreth_tpu.vm.syncervm import StateSyncClient, StateSyncServer
+
+        server, _ = build_server_vm(n_blocks=8)
+        sync_server = StateSyncServer(server.blockchain, syncable_interval=4)
+        old_summary = sync_server.get_state_summary(4)
+        new_summary = sync_server.get_state_summary(8)
+        assert old_summary and new_summary
+        assert old_summary.block_root != new_summary.block_root
+        handler = SyncHandler(server.blockchain,
+                              server.state_database.triedb,
+                              server.blockchain.diskdb)
+        stale_root = old_summary.block_root
+
+        def pruned(sender, req_bytes):
+            msg = decode_message(req_bytes)
+            if isinstance(msg, LeafsRequest) and msg.root == stale_root:
+                return LeafsResponse().encode()  # the don't-have shape
+            return handler.handle(sender, req_bytes)
+
+        net = Network(self_id=b"client")
+        for name in (b"p1", b"p2", b"p3"):
+            net.connect(name, pruned)
+        client = SyncClient(net, stale_root_votes=3, backoff_base=0.002,
+                            backoff_cap=0.02)
+        client_vm = self._client_vm(server)
+        sync_client = StateSyncClient(client_vm, client,
+                                      summary_provider=lambda: new_summary)
+        sync_client.accept_summary(old_summary)
+
+        assert client_vm.blockchain.last_accepted.hash() \
+            == new_summary.block_hash
+        assert sync_client.pivot_history == [
+            {"fromHeight": 4, "toHeight": 8,
+             "toRoot": new_summary.block_root.hex()[:16]}]
+        status = sync_client.status()
+        assert status["pivots"][0]["toHeight"] == 8
+        assert status["trie"]["phase"] == "done"
+        # completion cleared the resume marker
+        assert sync_client.ongoing_summary() is None
+        client_vm.shutdown()
+        server.shutdown()
+
+    def test_debug_sync_status_idle_vm(self):
+        from coreth_tpu.vm.api import DebugMetricsAPI
+
+        class _Bare:
+            pass
+
+        assert DebugMetricsAPI(_Bare()).syncStatus() == {"syncing": False}
