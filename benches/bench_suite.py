@@ -83,13 +83,15 @@ def bench_2():
     _emit(2, "intermediate_root_1m_nodes_per_sec", dev, "nodes/s", dev / cpu)
 
 
-def _block_insert_rate(resident: bool = False, state_backend: str = "mpt"):
+def _block_insert_rate(resident: bool = False, state_backend: str = "mpt",
+                       parallel_workers: int = 0):
     """1k-tx block processing: build the blocks, then time insert_block
     (ecrecover via the native batch + EVM + state commit). Returns
     (n_txs, txs_per_sec). resident=True routes the account trie through
     the device-resident mirror (CacheConfig.resident_account_trie);
     state_backend="bintrie-shadow" mounts the dual-root commitment
-    shadow (config-13 measures its overhead)."""
+    shadow (config-13 measures its overhead); parallel_workers>0 runs
+    the optimistic Block-STM executor (config-14 A/Bs it vs serial)."""
     from coreth_tpu import params
     from coreth_tpu.consensus.dummy import new_dummy_engine
     from coreth_tpu.core.blockchain import BlockChain, CacheConfig
@@ -115,7 +117,8 @@ def _block_insert_rate(resident: bool = False, state_backend: str = "mpt"):
     chain = BlockChain(
         diskdb,
         CacheConfig(pruning=True, resident_account_trie=resident,
-                    state_backend=state_backend),
+                    state_backend=state_backend,
+                    evm_parallel_workers=parallel_workers),
         params.TEST_CHAIN_CONFIG,
         genesis, new_dummy_engine(),
         state_database=Database(TrieDatabase(diskdb)),
@@ -655,6 +658,60 @@ def bench_13():
           shadow_rate / base_rate)
 
 
+def bench_14():
+    """Serial vs optimistic-parallel execution A/B (PERF.md r9): the
+    config-3 insert workload (disjoint-sender transfers — the
+    best-case, conflict-free shape) run serial then under a worker
+    sweep. Reports per-worker txs/s, the exec/parallel/* counter deltas
+    (conflicts/reexecs/fallbacks — all must be 0 on this workload: a
+    nonzero fallback means the engine bailed and the A/B is measuring
+    serial twice), and the chain/execute/{schedule,execute,validate,
+    fold} phase split. vs_baseline = best parallel txs/s / serial
+    txs/s. On a GIL-bound single-core host the win comes from the
+    journal-free view + fold, not thread parallelism — expect a modest
+    ratio here and report it honestly."""
+    from coreth_tpu.metrics import default_registry
+
+    counter_names = ("exec/parallel/conflicts", "exec/parallel/reexecs",
+                     "exec/parallel/fallbacks")
+    phase_names = ("chain/execute/schedule", "chain/execute/execute",
+                   "chain/execute/validate", "chain/execute/fold")
+
+    def _snap():
+        counters = {n: default_registry.counter(n).count()
+                    for n in counter_names}
+        phases = {n: default_registry.timer(n).total() for n in phase_names}
+        return counters, phases
+
+    _, serial_rate = _block_insert_rate()
+    sweep = {}
+    best_rate = 0.0
+    for workers in (1, 2, 4):
+        c0, p0 = _snap()
+        _, rate = _block_insert_rate(parallel_workers=workers)
+        c1, p1 = _snap()
+        modes = [r.get("parallel", {}).get("mode")
+                 for r in _LAST_INSERT_INFO.get("flight", [])]
+        sweep[workers] = {
+            "txs_per_sec": round(rate, 1),
+            "ratio_vs_serial": round(rate / serial_rate, 3),
+            "parallel_blocks": modes.count("parallel"),
+            "serial_blocks": len(modes) - modes.count("parallel"),
+            "counters": {n.rsplit("/", 1)[1]: c1[n] - c0[n]
+                         for n in counter_names},
+            "phases_s": {n.rsplit("/", 1)[1]: round(p1[n] - p0[n], 4)
+                         for n in phase_names},
+        }
+        best_rate = max(best_rate, rate)
+    print(json.dumps({
+        "config": 14,
+        "serial_txs_per_sec": round(serial_rate, 1),
+        "workers": sweep,
+    }), flush=True)
+    _emit(14, "parallel_block_insert_txs_per_sec", best_rate, "txs/s",
+          best_rate / serial_rate)
+
+
 def main():
     from coreth_tpu.utils import enable_compilation_cache
 
@@ -672,7 +729,7 @@ def main():
     watchdog = PhaseWatchdog(
         time.monotonic() + float(os.environ.get("CORETH_TPU_BENCH_WATCHDOG",
                                                 "1800")))
-    picks = [int(a) for a in sys.argv[1:]] or list(range(1, 14))
+    picks = [int(a) for a in sys.argv[1:]] or list(range(1, 15))
     for i in picks:
         # configs 7/9 run bench.py legs under their own phase watchdogs
         # with larger budgets (900s cold warmup); the outer arm must not

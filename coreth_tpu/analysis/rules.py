@@ -21,6 +21,12 @@ SA006 failpoint-hygiene  failpoint names are unique string literals
 SA007 serving-bounded  no unbounded `queue.Queue()` / `SimpleQueue()` or
                        un-capped `ThreadPoolExecutor()` in serving-path
                        modules — bounded queues ARE the admission control
+SA008 backend-isolation  trie/ and bintrie/ may not import each other —
+                       commitment backends meet only at the
+                       state/commitment.py seam
+SA009 fold-order       fold-step loops in the optimistic executor must
+                       iterate in tx-index order (range/sorted only) —
+                       completion-order folds break deterministic commit
 """
 
 from __future__ import annotations
@@ -489,7 +495,7 @@ class ConsensusFloatRule(Rule):
 UNORDERED_ITER_PATHS = CONSENSUS_FLOAT_PATHS + (
     "coreth_tpu/state/statedb.py", "coreth_tpu/state/snapshot.py",
     "coreth_tpu/trie/resident_mirror.py", "coreth_tpu/trie/planned.py",
-    "coreth_tpu/trie/triedb.py",
+    "coreth_tpu/trie/triedb.py", "coreth_tpu/core/parallel_exec.py",
 )
 ITER_UNWRAP = {"list", "tuple", "iter", "enumerate", "reversed"}
 SET_BINOPS = (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)
@@ -878,10 +884,84 @@ class BackendIsolationRule(Rule):
                 f"boundary — go through state/commitment.py instead"))
 
 
+# ------------------------------------------------------------------ SA009
+
+# Deterministic commit (PERF.md r9): the optimistic executor may finish
+# transactions in any order, but the fold that applies write-sets to the
+# real StateDB is the consensus boundary — it must walk the versioned
+# results strictly in tx-index order. A loop over a dict of completion
+# events or a worker-local list would be timing-dependent and fork the
+# state root. Enforced structurally: inside fold-named functions in the
+# executor, every for-loop (and comprehension) iterates an explicitly
+# ordered source — range()/sorted(), optionally wrapped in enumerate/
+# list/tuple — never a raw container or set.
+FOLD_ORDER_PATHS = ("coreth_tpu/core/parallel_exec.py",)
+FOLD_ORDER_WRAPPERS = {"enumerate", "list", "tuple", "iter"}
+FOLD_ORDER_SOURCES = {"range", "sorted"}
+
+
+class FoldOrderRule(Rule):
+    id = "SA009"
+    title = "fold-step iteration must be tx-index ordered"
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        if src.relpath not in FOLD_ORDER_PATHS:
+            return iter(())
+        rule = self
+        findings: List[Finding] = []
+
+        class V(QualnameVisitor):
+            def __init__(self):
+                super().__init__()
+                self._fold_depth = 0
+
+            def _visit_func(self, node) -> None:
+                folding = "fold" in node.name
+                self._fold_depth += folding
+                QualnameVisitor._visit_func(self, node)
+                self._fold_depth -= folding
+
+            visit_FunctionDef = _visit_func
+            visit_AsyncFunctionDef = _visit_func
+
+            def _check_iter(self, it: ast.AST, where: ast.AST) -> None:
+                if self._fold_depth and not rule._ordered_iter(it):
+                    findings.append(rule.finding(
+                        src, where, self.qualname,
+                        "fold-step loop must iterate range()/sorted() "
+                        "(tx-index order) — container iteration here is "
+                        "completion-order and forks the state root"))
+
+            def visit_For(self, node: ast.For) -> None:
+                self._check_iter(node.iter, node)
+                self.generic_visit(node)
+
+            def _visit_comp(self, node) -> None:
+                for gen in node.generators:
+                    self._check_iter(gen.iter, node)
+                self.generic_visit(node)
+
+            visit_ListComp = _visit_comp
+            visit_SetComp = _visit_comp
+            visit_DictComp = _visit_comp
+            visit_GeneratorExp = _visit_comp
+
+        V().visit(src.tree)
+        return iter(findings)
+
+    @staticmethod
+    def _ordered_iter(node: ast.AST) -> bool:
+        while (isinstance(node, ast.Call)
+               and dotted(node.func) in FOLD_ORDER_WRAPPERS and node.args):
+            node = node.args[0]
+        return (isinstance(node, ast.Call)
+                and dotted(node.func) in FOLD_ORDER_SOURCES)
+
+
 ALL_RULES: Tuple[type, ...] = (
     SilentExceptRule, LockDisciplineRule, HotPathPurityRule,
     ConsensusFloatRule, UnorderedIterationRule, FailpointHygieneRule,
-    ServingBoundednessRule, BackendIsolationRule,
+    ServingBoundednessRule, BackendIsolationRule, FoldOrderRule,
 )
 
 

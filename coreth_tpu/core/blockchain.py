@@ -117,6 +117,9 @@ class CacheConfig:
     cpu_threads: int = 0
     # bloom-bit index section (bloom_indexer.go BloomBitsBlocks)
     bloom_section_size: int = 4096
+    # Block-STM optimistic parallel execution workers (core/parallel_exec);
+    # 0 = seed serial loop. CORETH_TPU_EVM_PARALLEL overrides per-process.
+    evm_parallel_workers: int = 0
     # per-chain flight recorder: ring size of retained per-block phase
     # records (metrics/flight.py; served by debug_blockFlightRecord)
     flight_recorder_size: int = 64
@@ -301,7 +304,9 @@ class BlockChain:
         )
         self._tail_thread.start()
 
-        self.processor = StateProcessor(config, self, engine)
+        self.processor = StateProcessor(
+            config, self, engine,
+            parallel_workers=cache_config.evm_parallel_workers)
         self.validator = BlockValidator(config, self, engine)
         if cache_config.pruning:
             self.trie_writer = CappedMemoryTrieWriter(
@@ -909,6 +914,7 @@ class BlockChain:
                 with _PhaseClock("execute", phases, _metrics):
                     receipts, logs, used_gas = self.processor.process(
                         block, parent, statedb)
+                rec["parallel"] = dict(self.processor.last_parallel)
                 with _PhaseClock("validate", phases, _metrics):
                     self.validator.validate_state(
                         block, statedb, receipts, used_gas)

@@ -10,8 +10,10 @@ from __future__ import annotations
 from typing import List, Optional, Tuple
 
 from ..evm.evm import EVM, BlockContext, Config, TxContext
+from ..metrics import default_registry as _metrics
 from ..metrics.spans import span
 from ..native import keccak256
+from . import parallel_exec
 from .state_transition import GasPool, Message, apply_message, tx_as_message
 from .types import Block, Header, Receipt, Signer
 
@@ -85,10 +87,16 @@ def apply_message_to_receipt(config, evm: EVM, gp: GasPool, statedb, header: Hea
 
 
 class StateProcessor:
-    def __init__(self, config, chain, engine):
+    def __init__(self, config, chain, engine, parallel_workers: int = 0):
         self.config = config
         self.chain = chain
         self.engine = engine
+        # evm-parallel-workers knob (0 = serial); CORETH_TPU_EVM_PARALLEL
+        # overrides per-process at block time
+        self.parallel_workers = parallel_workers
+        # stats of the most recent process() call, consumed by the
+        # chain's flight recorder ("parallel" field)
+        self.last_parallel: dict = {"mode": "serial"}
 
     def process(self, block: Block, parent: Header, statedb,
                 vm_config: Config = None) -> Tuple[list, list, int]:
@@ -105,6 +113,35 @@ class StateProcessor:
 
         block_ctx = new_block_context(header, self.chain)
         evm = EVM(block_ctx, TxContext(), statedb, self.config, vm_config or Config())
+
+        workers = parallel_exec.effective_workers(self.parallel_workers)
+        self.last_parallel = {"mode": "serial"}
+        parallel = None
+        if (workers > 0
+                and len(block.transactions) >= parallel_exec.MIN_PARALLEL_TXS
+                and (vm_config is None or vm_config.tracer is None)
+                and self.config.is_byzantium(header.number)):
+            try:
+                parallel, stats = parallel_exec.execute_block(
+                    self.config, block, parent, statedb, block_ctx,
+                    vm_config or Config(), workers,
+                )
+            except Exception:
+                # optimistic path must never take down block processing:
+                # the fold is its only StateDB mutation and it runs last,
+                # so the serial loop below re-executes from pristine state
+                _metrics.counter("exec/parallel/fallbacks").inc()
+                parallel, stats = None, {
+                    "mode": "serial", "workers": workers, "conflicts": 0,
+                    "reexecs": 0, "deps": 0, "fallback": True,
+                }
+            self.last_parallel = stats
+
+        if parallel is not None:
+            receipts, all_logs, used_gas[0] = parallel
+            with span("chain/execute/finalize"):
+                self.engine.finalize(self.config, block, parent, statedb, receipts)
+            return receipts, all_logs, used_gas[0]
 
         with span("chain/execute/txs", number=block.number,
                   txs=len(block.transactions)):

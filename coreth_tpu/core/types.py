@@ -254,11 +254,17 @@ class Signer:
             raise ValueError("invalid chain id for signer")
         return tx.v, True
 
-    def sender_batch(self, txs) -> None:
+    def sender_batch(self, txs, native_threads: int = 0) -> None:
         """Batch-recover senders into each tx's cache — the sender-cacher
         drain (core/sender_cacher.go:88-115). Uses the native batched
         secp256k1 when available; silently leaves invalid txs uncached so
-        the per-tx sender() surfaces the precise error later."""
+        the per-tx sender() surfaces the precise error later.
+
+        native_threads is forwarded to the native recover pool (0 = its
+        hardware-concurrency default); sharded callers pass 1 so each
+        shard owns one core and the Python-side item building (RLP +
+        sig-hash keccak) of one shard overlaps the GIL-released native
+        recovery of the others."""
         from ..native import secp
 
         todo = [tx for tx in txs if tx._sender is None]
@@ -289,7 +295,7 @@ class Signer:
             items.append((self.sig_hash(tx, protected=protected),
                           recid, tx.r, tx.s))
             ok_idx.append(i)
-        addrs = secp.recover_batch(items)
+        addrs = secp.recover_batch(items, threads=native_threads)
         for i, addr in zip(ok_idx, addrs):
             if addr is not None:
                 todo[i]._sender = addr

@@ -9,6 +9,7 @@ Each record is a plain dict built by core/blockchain during insert:
                 seconds, ...},
      "resident": {phase: seconds, ...},      # resident/phase/* deltas
      "counters": {name: delta, ...},         # snap + plan-cache + keccak
+     "parallel": {"mode": ..., ...},         # optimistic-executor verdict
      "host_mode": bool | None,               # device vs host hashing
      "accepted": bool, "seq": int}
 
@@ -122,7 +123,7 @@ def marshal_record(rec: Dict[str, object]) -> Dict[str, object]:
     h = out.get("hash")
     if isinstance(h, (bytes, bytearray)):
         out["hash"] = "0x" + bytes(h).hex()
-    for k in ("phases", "counters", "resident"):
+    for k in ("phases", "counters", "resident", "parallel"):
         if isinstance(out.get(k), dict):
             out[k] = dict(out[k])
     return out

@@ -78,6 +78,11 @@ class Config:
     # reverts to the legacy dict-dispatch loop. The CORETH_TPU_EVM_FASTLOOP
     # env var overrides either way.
     evm_fastloop: bool = True
+    # Block-STM optimistic parallel execution workers (core/parallel_exec):
+    # transactions execute concurrently against versioned reads and fold
+    # deterministically in tx-index order. 0 (default) keeps the serial
+    # loop; the CORETH_TPU_EVM_PARALLEL env var overrides either way.
+    evm_parallel_workers: int = 0
 
     # --- pruning ----------------------------------------------------------
     pruning_enabled: bool = True
@@ -253,6 +258,10 @@ class Config:
         if self.cpu_threads < 0:
             raise ValueError(
                 f"cpu-threads must be >= 0 (got {self.cpu_threads})")
+        if not (0 <= self.evm_parallel_workers <= 64):
+            raise ValueError(
+                f"evm-parallel-workers must be in [0, 64] "
+                f"(got {self.evm_parallel_workers})")
         if self.device_call_timeout < 0:
             raise ValueError(
                 f"device-call-timeout must be >= 0 "

@@ -218,6 +218,7 @@ class VM:
                 tail_join_timeout=full.tail_join_timeout,
                 state_backend=full.state_backend,
                 shadow_check_interval=full.shadow_check_interval,
+                evm_parallel_workers=full.evm_parallel_workers,
             ),
             self.chain_config,
             genesis,
