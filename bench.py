@@ -523,9 +523,12 @@ def run_resident(wd, planned_kernel="xla"):
 
     if load_inc() is None:
         return {"res_error": "native incremental planner unavailable"}
+    from coreth_tpu.native.mpt import IncrementalTrie
+
     wd.arm("resident-build", 300)
-    rng, cpu_tree, dev_tree, keys, n, churn, rounds, threads = \
-        build_inc_workload()
+    rng, items, keys, n, churn, rounds, threads = _inc_items()
+    cpu_tree = IncrementalTrie(items)
+    dev_tree = IncrementalTrie(items)
     seg_impl = None
     if planned_kernel == "pallas":
         from coreth_tpu.ops.keccak_pallas import staged_seg_impl
@@ -574,9 +577,15 @@ def run_resident(wd, planned_kernel="xla"):
         dev_tree.update(batch)
         handles.append(dev_tree.commit_resident(ex))
         h2d_total += ex.h2d_bytes
-    # single synchronization point: block on the last root
+    # single synchronization point: block on the last root. The time
+    # spent blocked here is device work the host could NOT hide behind
+    # planning — its complement is the pipeline's overlap fraction.
+    t_sync = time.perf_counter()
     np.asarray(handles[-1])
     dev_t = time.perf_counter() - t_start
+    blocked = time.perf_counter() - t_sync
+    out["res_overlap_fraction"] = round(
+        max(0.0, 1.0 - blocked / dev_t), 3) if dev_t > 0 else 0.0
 
     # verify every pipelined root against the host oracle
     wd.arm("resident-verify", 300)
@@ -594,19 +603,50 @@ def run_resident(wd, planned_kernel="xla"):
     out["res_vs_cpu"] = round(cpu_t / dev_t, 3)
     # bandwidth model: measured h2d at the two observed tunnel rates
     per_commit = h2d_total / rounds
+    out["res_h2d_bytes_per_commit"] = int(per_commit)
     out["res_modeled_transfer_s_at_90MBps"] = round(per_commit / 90e6, 3)
     out["res_modeled_transfer_s_at_1600MBps"] = round(per_commit / 1.6e9, 3)
+
+    # ----------------------------------------- template-residency leg
+    # Same batches through commit_template: the device keeps the arenas
+    # (resident-path h2d cost) while every commit's digests absorb into
+    # the host cache (planned-path semantics: root()/export always
+    # valid, takeover without a full rehash). The absorb is a sync, so
+    # this leg is the SERIAL floor the pipelined leg above is measured
+    # against.
+    wd.arm("resident-template-build", 600)
+    tmpl_tree = IncrementalTrie(items)
+    ex_t = ResidentExecutor(seg_impl=seg_impl)
+    wd.arm("resident-template-warmup", 900)
+    rt = tmpl_tree.commit_template(ex_t)
+    assert rt == r0_cpu, "template initial root mismatch"
+    tmpl_tree.update(batches[0])
+    assert tmpl_tree.commit_template(ex_t) == cpu_roots[0], \
+        "template warmup root mismatch"
+    wd.arm("resident-template-measure", 900)
+    tmpl_t, tmpl_h2d = 0.0, 0
+    for rnd, batch in enumerate(batches[1:]):
+        t0 = time.perf_counter()
+        tmpl_tree.update(batch)
+        root = tmpl_tree.commit_template(ex_t)
+        tmpl_t += time.perf_counter() - t0
+        tmpl_h2d += ex_t.h2d_bytes
+        assert root == cpu_roots[rnd + 1], \
+            f"template root mismatch (round {rnd})"
+    out["res_template_nodes_per_sec"] = round(dirty_total / tmpl_t, 1)
+    out["res_template_vs_cpu"] = round(cpu_t / tmpl_t, 3)
+    out["res_template_h2d_bytes_per_node"] = round(
+        tmpl_h2d / max(dirty_total, 1), 1)
+    out["res_template_h2d_bytes_per_commit"] = int(tmpl_h2d / rounds)
     return out
 
 
 
-def build_inc_workload():
-    """Shared setup for the incremental/resident legs: env knobs, the
-    deterministic leaf set (seed 7), and a fresh CPU+device trie pair.
-    Returns (rng, cpu_tree, dev_tree, keys, n, churn, rounds, threads)."""
+def _inc_items():
+    """Env knobs + the deterministic leaf set (seed 7) shared by the
+    incremental/resident legs. Returns
+    (rng, items, keys, n, churn, rounds, threads)."""
     import random
-
-    from coreth_tpu.native.mpt import IncrementalTrie
 
     n = int(os.environ.get("CORETH_TPU_BENCH_INC_LEAVES", "1000000"))
     churn = int(os.environ.get("CORETH_TPU_BENCH_INC_CHURN", "50000"))
@@ -619,9 +659,19 @@ def build_inc_workload():
         {rng.randbytes(32): rng.randbytes(rng.randint(40, 90))
          for _ in range(n)}.items()
     )
+    keys = [k for k, _ in items]
+    return rng, items, keys, n, churn, rounds, threads
+
+
+def build_inc_workload():
+    """Shared setup for the incremental/resident legs: env knobs, the
+    deterministic leaf set (seed 7), and a fresh CPU+device trie pair.
+    Returns (rng, cpu_tree, dev_tree, keys, n, churn, rounds, threads)."""
+    from coreth_tpu.native.mpt import IncrementalTrie
+
+    rng, items, keys, n, churn, rounds, threads = _inc_items()
     cpu_tree = IncrementalTrie(items)
     dev_tree = IncrementalTrie(items)
-    keys = [k for k, _ in items]
     return rng, cpu_tree, dev_tree, keys, n, churn, rounds, threads
 
 

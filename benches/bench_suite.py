@@ -84,11 +84,15 @@ def bench_2():
 
 
 def _block_insert_rate(resident: bool = False, state_backend: str = "mpt",
-                       parallel_workers: int = 0):
+                       parallel_workers: int = 0, pipeline_depth: int = 0,
+                       template_residency: bool = False):
     """1k-tx block processing: build the blocks, then time insert_block
     (ecrecover via the native batch + EVM + state commit). Returns
     (n_txs, txs_per_sec). resident=True routes the account trie through
     the device-resident mirror (CacheConfig.resident_account_trie);
+    pipeline_depth>0 lets that many verified commits stay in flight on
+    the device (config-10's pipelined A/B leg); template_residency=True
+    runs the planned-semantics/resident-cost template mode;
     state_backend="bintrie-shadow" mounts the dual-root commitment
     shadow (config-13 measures its overhead); parallel_workers>0 runs
     the optimistic Block-STM executor (config-14 A/Bs it vs serial)."""
@@ -118,7 +122,9 @@ def _block_insert_rate(resident: bool = False, state_backend: str = "mpt",
         diskdb,
         CacheConfig(pruning=True, resident_account_trie=resident,
                     state_backend=state_backend,
-                    evm_parallel_workers=parallel_workers),
+                    evm_parallel_workers=parallel_workers,
+                    resident_pipeline_depth=pipeline_depth,
+                    resident_template_residency=template_residency),
         params.TEST_CHAIN_CONFIG,
         genesis, new_dummy_engine(),
         state_database=Database(TrieDatabase(diskdb)),
@@ -474,7 +480,8 @@ def bench_9():
               out["res_tpu_nodes_per_sec"], "nodes/s", out["res_vs_cpu"])
         print(json.dumps({"config": 9, **{
             k: v for k, v in out.items()
-            if k.startswith("res_h2d") or k.startswith("res_modeled")
+            if k.startswith(("res_h2d", "res_modeled", "res_overlap",
+                             "res_template"))
         }}), flush=True)
     else:
         print(json.dumps({"config": 9, **out}), flush=True)
@@ -495,10 +502,14 @@ def _flight_attribution(recs):
     phases: dict = {}
     resident: dict = {}
     counters: dict = {}
+    overlaps: list = []
     for rec in recs:
         for k, v in rec.get("phases", {}).items():
             phases[k] = phases.get(k, 0.0) + v
         for k, v in rec.get("resident", {}).items():
+            if k == "overlap_fraction":  # a ratio, not a duration
+                overlaps.append(v)
+                continue
             resident[k] = resident.get(k, 0.0) + v
         for k, v in rec.get("counters", {}).items():
             counters[k] = counters.get(k, 0) + v
@@ -506,6 +517,14 @@ def _flight_attribution(recs):
     for k in sorted(resident):
         if resident[k] > 0:
             out[k + "_s"] = round(resident[k], 4)
+    if overlaps:
+        out["overlap_fraction_mean"] = round(
+            sum(overlaps) / len(overlaps), 4)
+        out["overlap_fraction_max"] = round(max(overlaps), 4)
+    h2d = counters.get("resident/h2d_bytes", 0)
+    if h2d:
+        out["h2d_mb"] = round(h2d / 1e6, 2)
+        out["h2d_bytes_per_block"] = int(h2d / max(len(recs), 1))
     for k in sorted(phases):
         if phases[k] > 0:
             out["chain_" + k + "_s"] = round(phases[k], 4)
@@ -529,6 +548,11 @@ def bench_10():
     that ate the time instead of just the headline tx/s."""
     from coreth_tpu.native import default_cpu_threads
 
+    # CPU legs land FIRST (before any device op warps process state):
+    # the default-path baseline, reused from bench_3 when available
+    base_rate = _DEFAULT_INSERT_RATE
+    if base_rate is None:
+        _, base_rate = _block_insert_rate(resident=False)
     try:
         # cold pass seeds the per-segment-shape jit compiles (persisted by
         # the compilation cache; a node restart reuses them) — the warm
@@ -540,9 +564,6 @@ def bench_10():
     except RuntimeError as e:
         print(json.dumps({"config": 10, "skipped": str(e)}), flush=True)
         return
-    base_rate = _DEFAULT_INSERT_RATE
-    if base_rate is None:
-        _, base_rate = _block_insert_rate(resident=False)
     _emit(10, "resident_block_insert_txs_per_sec", res_rate, "txs/s",
           res_rate / base_rate)
     print(json.dumps({
@@ -555,6 +576,39 @@ def bench_10():
         "phases_warm": warm_phases,
         "note": "cold = first-ever run compiling per-segment-shape device "
                 "programs (persisted; restarts reuse them)",
+    }), flush=True)
+
+    # A/B legs: cross-commit pipelining (depth 2) and template
+    # residency vs the serial resident leg above. Warm numbers (one
+    # cold pass each to land compiles); the flight attribution carries
+    # h2d bytes per block and the measured overlap fraction — the
+    # artifact for "pipelining buys nodes/max(plan, transfer)".
+    try:
+        _block_insert_rate(resident=True, pipeline_depth=2)
+        _, pipe_rate = _block_insert_rate(resident=True, pipeline_depth=2)
+        pipe_phases = _flight_attribution(
+            _LAST_INSERT_INFO.get("flight", []))
+        _block_insert_rate(resident=True, template_residency=True)
+        _, tmpl_rate = _block_insert_rate(resident=True,
+                                          template_residency=True)
+        tmpl_phases = _flight_attribution(
+            _LAST_INSERT_INFO.get("flight", []))
+    except RuntimeError as e:
+        print(json.dumps({"config": 10, "ab_skipped": str(e)}), flush=True)
+        return
+    print(json.dumps({
+        "config": 10,
+        "ab": "pipelined-depth-2 / template-residency vs serial resident",
+        # host_mode=True means the CPU fast path auto-engaged (no TPU
+        # backend): pipelining/template are inert and the A/B reads ~1.0
+        # by construction — the device-side artifact is config 9's.
+        "host_mode": _LAST_INSERT_INFO.get("host_mode"),
+        "pipelined_txs_per_sec": round(pipe_rate, 1),
+        "pipelined_vs_serial_resident": round(pipe_rate / res_rate, 3),
+        "template_txs_per_sec": round(tmpl_rate, 1),
+        "template_vs_serial_resident": round(tmpl_rate / res_rate, 3),
+        "phases_pipelined": pipe_phases,
+        "phases_template": tmpl_phases,
     }), flush=True)
 
 

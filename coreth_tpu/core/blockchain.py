@@ -139,6 +139,17 @@ class CacheConfig:
     # resident-mirror spot check (device root vs host keccak oracle)
     # every K committed inserts; 0 disables
     resident_spot_check_interval: int = 0
+    # cross-commit device pipelining: up to this many resident commits
+    # stay in flight on the device, verified against their header roots
+    # at the next drain point (accept/reject/reorg/spot-check/export) —
+    # host planning of block k+1 overlaps device execution of block k.
+    # 0 = every commit synchronizes before verify returns
+    resident_pipeline_depth: int = 0
+    # template residency: keep the planned path's host digest cache warm
+    # (per-commit device->host digest absorb) while the device keeps row
+    # arenas + store resident, so uploads carry only fresh leaf content.
+    # Excludes pipelining (the per-commit absorb IS a sync)
+    resident_template_residency: bool = False
     # deadline (seconds) for join_tail / acceptor-queue joins; on expiry
     # they raise TailStalled instead of blocking forever. 0 = unbounded
     tail_join_timeout: float = 0.0
@@ -160,6 +171,7 @@ class CacheConfig:
 _FLIGHT_COUNTERS = (
     "state/snap/hits", "state/snap/misses", "state/snap/generating",
     "resident/plan_cache/hits", "resident/plan_cache/misses",
+    "resident/h2d_bytes",
     "trie/keccak/batches", "trie/keccak/batch_msgs",
 )
 _FLIGHT_TIMERS = (
@@ -611,6 +623,9 @@ class BlockChain:
             device_timeout=self.cache_config.resident_commit_timeout,
             cpu_threads=self.cache_config.cpu_threads,
             prefer_host=None if prefer == "auto" else bool(prefer),
+            pipeline_depth=self.cache_config.resident_pipeline_depth,
+            template_residency=(
+                self.cache_config.resident_template_residency),
         )
         self.mirror.on_takeover = self._on_mirror_takeover
         self.state_database.mirror = self.mirror
@@ -881,6 +896,13 @@ class BlockChain:
                 for n in _FLIGHT_TIMERS
                 if (d := _metrics.timer(n).total() - timers0[n]) > 0.0
             }
+            if mirror is not None and mirror.last_overlap_fraction > 0.0:
+                # overlap of the most recently DRAINED pipelined commit
+                # (drains lag dispatch by up to the window depth, so
+                # this reads one-to-two blocks behind the record it
+                # lands in — good enough for the A/B artifact)
+                rec["resident"]["overlap_fraction"] = round(
+                    mirror.last_overlap_fraction, 4)
 
     def _insert_phases(self, block: Block, header: Header, parent: Header,
                        writes: bool, rec: dict, phases: Dict[str, float],
@@ -906,6 +928,13 @@ class BlockChain:
             sender_cacher.wait()
 
         statedb = self.state_at(parent.root)
+        if getattr(statedb.trie, "resident", False):
+            # hand the header root to the mirror: with pipelining on,
+            # validate/commit dispatch against it and the device-root
+            # compare defers to the mirror's next drain point (a
+            # divergence there rewinds and falls back to the disk path,
+            # whose TRUE roots still fail consensus for a bad block)
+            statedb.trie.expected_root = header.root
         # warm touched trie paths while txs execute (blockchain.go:1312)
         statedb.start_prefetcher("chain")
 

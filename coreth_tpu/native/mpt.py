@@ -22,7 +22,16 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import default_cpu_threads  # noqa: F401  (re-export: one policy)
+from ..fault import failpoint
+from ..fault import register as _register_failpoint
 from ..metrics import phase_timer
+
+FP_BEFORE_ABSORB = _register_failpoint(
+    "resident/before_absorb",
+    "fires inside the device-sync half of a resident commit, just before "
+    "its digests are absorbed/synchronized: `hang` wedges a pipelined "
+    "drain mid-window (the watchdog then fires and host takeover must "
+    "reproduce every in-flight root bit-exactly)")
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "mpt.cpp")
@@ -349,6 +358,8 @@ def load_inc():
         ]
         lib.mpt_inc_res_mark_clean.restype = None
         lib.mpt_inc_res_mark_clean.argtypes = [ctypes.c_void_p]
+        lib.mpt_inc_res_absorb.restype = None
+        lib.mpt_inc_res_absorb.argtypes = [ctypes.c_void_p, _u8p, _u8p]
         lib.mpt_inc_mark_all_dirty.restype = None
         lib.mpt_inc_mark_all_dirty.argtypes = [ctypes.c_void_p]
         lib.mpt_inc_checkpoint.restype = None
@@ -418,7 +429,7 @@ def _run_with_watchdog(fn, timeout: float, what: str):
     t.start()
     if not done.wait(timeout):
         raise DeviceWedgedError(
-            f"{what} produced nothing within {timeout:.0f}s")
+            f"{what} produced nothing within {timeout:g}s")
     if "err" in box:
         raise box["err"]
     return box["val"]
@@ -681,6 +692,95 @@ class IncrementalTrie:
         root = executor.run(export)
         self._lib.mpt_inc_res_mark_clean(self._h)
         return root
+
+    def commit_resident_dispatch(self, executor,
+                                 timeout: Optional[float] = None):
+        """Pipelined resident commit: plan + dispatch WITHOUT waiting for
+        the device, then return a resolve() closure that synchronizes the
+        root later. Between dispatch and resolve the caller may plan and
+        dispatch further commits against the same executor — their patch
+        tables reference this commit's still-in-flight digest store
+        directly (JAX async dispatch keeps device programs ordered), so
+        host planning of commit k+1 overlaps device execution of commit
+        k: nodes/max(plan, transfer) instead of nodes/(plan + transfer).
+
+        Every native-trie mutation (plan export, res_mark_clean) happens
+        on the calling thread before return; resolve() touches only the
+        executor handle, so a watchdog-abandoned resolve can never race
+        a host takeover's rehash on this trie's memory."""
+        if self.num_nodes == 0:
+            root = executor.root_bytes(self.commit_resident(executor))
+            return lambda: root
+        self._check_mode("resident")
+        executor.check_binding(self)
+        export = self.export_resident_plan()
+        self._pin_mode("resident")
+        executor.bind(self)
+        if export is None:
+            handle = executor.last_root
+        else:
+            if timeout is None:
+                handle = executor.run(export)
+            else:
+                handle = _run_with_watchdog(
+                    lambda: executor.run(export), timeout,
+                    "resident dispatch")
+            self._lib.mpt_inc_res_mark_clean(self._h)
+
+        def resolve() -> bytes:
+            def sync():
+                failpoint("resident/before_absorb")
+                return executor.root_bytes(handle)
+
+            if timeout is None:
+                return sync()
+            return _run_with_watchdog(sync, timeout, "resident drain")
+
+        return resolve
+
+    def commit_template(self, executor, timeout: Optional[float] = None):
+        """Template-resident planned commit: the device keeps this trie's
+        row arenas + digest store across commits (dirty BRANCH rows are
+        re-zeroed/re-patched on device, uploads carry only fresh content
+        — ~70 B/leaf instead of ~320 B/dirty node), but unlike the pure
+        resident mode the per-commit digest matrix IS read back and
+        absorbed into the host cache. root()/export_nodes() stay valid
+        every commit and a device-failure takeover needs no full rehash
+        — the planned path's semantics at the resident path's h2d cost.
+
+        Interleaving with commit_cpu would corrupt the device store
+        (fresh rows reference clean children by store slot, which a host
+        commit never updates), so this pins its own 'template' mode."""
+        if self.num_nodes == 0:
+            self._pin_mode("template")
+            executor.bind(self)
+            return EMPTY_ROOT
+        self._check_mode("template")
+        executor.check_binding(self)
+        export = self.export_resident_plan()
+        self._pin_mode("template")
+        executor.bind(self)
+        if export is None:
+            return self.root()
+
+        def sync():
+            executor.run(export)
+            failpoint("resident/before_absorb")
+            return np.asarray(executor.last_dig)
+
+        if timeout is None:
+            dig = sync()
+        else:
+            dig = _run_with_watchdog(sync, timeout, "template commit")
+        # strip the zero-sentinel row: the native absorb expects global
+        # lane order exactly like the planned path's digest matrix
+        dig8 = np.ascontiguousarray(dig[1:]).view(np.uint8).reshape(-1)
+        out = np.empty(32, np.uint8)
+        with phase_timer("resident/phase/absorb"):
+            self._lib.mpt_inc_res_absorb(self._h, dig8, out)
+        if int(export["root_lane"]) < 0:
+            return self.root()  # root not among this plan's lanes
+        return out.tobytes()
 
     # ---- checkpoint / rollback (the chain adapter's verify->reject
     # enabler: core/blockchain.go:1424 reorg, plugin/evm/block.go:173) ----

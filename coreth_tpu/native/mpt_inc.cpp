@@ -901,6 +901,31 @@ void res_mark_clean(Inc& t) {
   t.r_embedded_dirty.clear();
 }
 
+// Template-residency absorb: the resident plan ran on device but the
+// host cache still wants every digest (so root()/export_nodes work and
+// a device-failure takeover needs no full rehash). dig is the device's
+// per-lane digest matrix WITHOUT the zero-sentinel row, laid out in
+// global lane order (seg.gstart + lane), exactly absorb_digests' shape
+// for the planned path. Folds in res_mark_clean so callers do one or
+// the other, never both.
+void res_absorb_digests(Inc& t, const uint8_t* dig) {
+  for (auto& seg : t.rsegs)
+    for (size_t lane = 0; lane < seg.node_of_lane.size(); ++lane) {
+      INode* n = seg.node_of_lane[lane];
+      std::memcpy(n->digest, dig + ((int64_t)seg.gstart + lane) * 32, 32);
+      n->dirty = false;
+      n->unexported = true;
+      n->structural = false;
+      n->lane = -1;
+    }
+  for (INode* n : t.r_embedded_dirty) {
+    n->dirty = false;
+    n->unexported = true;
+    n->structural = false;
+  }
+  t.r_embedded_dirty.clear();
+}
+
 void absorb_digests(Inc& t, const uint8_t* dig) {
   for (auto& seg : t.segs)
     for (size_t lane = 0; lane < seg.node_of_lane.size(); ++lane) {
@@ -1209,6 +1234,18 @@ void mpt_inc_res_tables(void* h, int32_t* rowidx, int32_t* lane_slot,
 // After the device program is dispatched: clear dirty/structural flags.
 // Digests deliberately do NOT return to the host (deferred absorb).
 void mpt_inc_res_mark_clean(void* h) { res_mark_clean(*(Inc*)h); }
+
+// Template-residency variant: the resident plan's digest matrix came
+// back (uint8[total_lanes * 32], global lane order, sentinel row already
+// stripped) — absorb it into the host cache AND clear the dirty flags.
+// out_root32 gets the root digest when the root was among this commit's
+// lanes (r_root_lane >= 0), else stays untouched.
+void mpt_inc_res_absorb(void* h, const uint8_t* dig, uint8_t* out_root32) {
+  Inc* t = (Inc*)h;
+  if (t->r_root_lane >= 0)
+    std::memcpy(out_root32, dig + (int64_t)t->r_root_lane * 32, 32);
+  res_absorb_digests(*t, dig);
+}
 
 // Device-failure takeover seam: mark EVERY node dirty so the next host
 // plan re-hashes the whole trie. After a resident (device-store) commit

@@ -169,14 +169,27 @@ class ResidentExecutor:
         # staging buffers, keyed by the commit's segment-shape signature.
         # Warm commits (steady-state chain: same dirty-set bucket shapes
         # block after block) skip jit tracing and refill preallocated
-        # aux/rows buffers in place instead of re-concatenating
+        # aux/rows buffers in place instead of re-concatenating.
+        # Staging is a RING per signature: with cross-commit pipelining
+        # (pipeline_depth > 0) up to depth+1 commits' buffers may be
+        # in flight at once, so each ring entry remembers the lazy root
+        # of the commit that consumed it and is only rewritten once THAT
+        # commit has settled — never the whole pipeline
         self._fused_cache: dict = {}
         self._staging: dict = {}
+        # bounded in-flight window for deferred-absorb pipelining: 0 =
+        # every dispatch settles the previous commit before staging reuse
+        # (the pre-pipelining behaviour); k = up to k commits may still
+        # be executing on device while the next one is planned/dispatched
+        self.pipeline_depth = 0
         # diagnostics for PERF.md / bench: bytes actually shipped
         self.h2d_bytes = 0
         self.last_transfers = 0
         self.last_dispatches = 0
         self.last_cache_hit = False
+        # full digest matrix of the last run (lazy, includes the zero-
+        # sentinel row 0) — template residency absorbs it host-side
+        self.last_dig: Optional[jax.Array] = None
 
     def _pin(self, arr: jax.Array) -> jax.Array:
         if self.sharding is None:
@@ -333,23 +346,30 @@ class ResidentExecutor:
 
             # staging reuse (the plan cache's host half): warm commits
             # refill this signature's preallocated aux/rows buffers in
-            # place instead of re-concatenating ~10 arrays. The previous
+            # place instead of re-concatenating ~10 arrays. A dispatched
             # commit's program may still be consuming these exact
             # buffers (device_put can alias host memory on the CPU
-            # backend), so reuse first settles the in-flight root —
-            # free once per-commit roots are synchronized anyway
-            staging = self._staging.get(key)
-            if staging is not None and hasattr(self.last_root,
-                                               "block_until_ready"):
-                self.last_root.block_until_ready()
-            if staging is None:
+            # backend), so each ring entry carries the lazy root of the
+            # commit that consumed it and is only rewritten once that
+            # commit has settled. Ring size pipeline_depth+1 keeps up to
+            # `pipeline_depth` commits in flight without ever blocking
+            # on the newest dispatch — the AlDBaran overlap window
+            ring = self._staging.get(key)
+            if ring is None:
+                ring = self._staging[key] = []
+            want = max(0, int(self.pipeline_depth)) + 1
+            while len(ring) > want:  # depth was lowered: shrink the ring
+                ring.pop(0)
+            if len(ring) >= want:
+                aux, rows_packed, busy = ring.pop(0)
+                if busy is not None and hasattr(busy, "block_until_ready"):
+                    busy.block_until_ready()
+            else:
                 n_aux = (3 * len_off + len_rowidx + g_pad
                          + sum(b for _, b, _ in fresh_t))
                 n_rows = sum(b * w for _, b, w in fresh_t)
-                staging = (np.zeros(n_aux, np.int32),
-                           np.zeros(max(n_rows, 1), np.uint32))
-                self._staging[key] = staging
-            aux, rows_packed = staging
+                aux = np.zeros(n_aux, np.int32)
+                rows_packed = np.zeros(max(n_rows, 1), np.uint32)
             p = 0
             aux[p:p + len_off] = export["off"]; p += len_off
             aux[p:p + len_off] = export["src"]; p += len_off
@@ -383,7 +403,16 @@ class ResidentExecutor:
             self.h2d_bytes = rows_packed[:rp].nbytes + aux.nbytes
             self.last_transfers = 2
             self.last_dispatches = 1
+            self.last_dig = dig
             self.last_root = dig[int(export["root_lane"]) + 1]
+            # return the staging buffers to the ring tagged with THIS
+            # commit's lazy root — the reuse gate above blocks on it
+            self._staging.setdefault(key, []).append(
+                (aux, rows_packed, self.last_root))
+            from ..metrics import default_registry
+
+            default_registry.counter("resident/h2d_bytes").inc(
+                self.h2d_bytes)
         return self.last_root
 
     # ---- one commit ----
@@ -455,7 +484,11 @@ class ResidentExecutor:
         self.h2d_bytes = h2d
         self.last_transfers = 7 + len(export["fresh"]) * 2
         self.last_dispatches = 1 + len(specs) + len(export["fresh"])
+        self.last_dig = dig
         self.last_root = dig[int(export["root_lane"]) + 1]
+        from ..metrics import default_registry
+
+        default_registry.counter("resident/h2d_bytes").inc(self.h2d_bytes)
         return self.last_root
 
     @staticmethod

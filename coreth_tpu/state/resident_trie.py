@@ -54,6 +54,12 @@ class MirrorStateTrie:
         self._buffer: Dict[bytes, bytes] = {}
         self._preview_root: Optional[bytes] = None
         self._fallback = None
+        # the header root the chain expects this block's state to have
+        # (set by core/blockchain before validate): with pipelining on,
+        # the mirror dispatches against it and defers the device-root
+        # compare to the next drain point. None = serial (miners,
+        # generation, tests — anywhere the true root is the answer).
+        self.expected_root: Optional[bytes] = None
 
     # ---- secure-trie key handling ---------------------------------------
 
@@ -99,7 +105,8 @@ class MirrorStateTrie:
             parent = self.mirror.key_for_root(self.root)
             if parent is None:
                 raise MirrorError("root not resident")
-            root = self.mirror.preview(parent, batch)
+            root = self.mirror.preview(parent, batch,
+                                       expected_root=self.expected_root)
         except MirrorError:
             root = self._disk_apply().hash()
         self._preview_root = root
@@ -123,8 +130,12 @@ class MirrorStateTrie:
             if parent is None:
                 raise MirrorError("root not resident")
             if block_hash is None:
-                return self.mirror.preview(parent, batch), None
-            return self.mirror.verify(parent, block_hash, batch), None
+                return self.mirror.preview(
+                    parent, batch,
+                    expected_root=self.expected_root), None
+            return self.mirror.verify(
+                parent, block_hash, batch,
+                expected_root=self.expected_root), None
         except MirrorError as e:
             # a fallen-back block's root never registers in the mirror, so
             # every descendant falls back too: resident mode is effectively
@@ -171,6 +182,7 @@ class MirrorStateTrie:
         t = MirrorStateTrie(self.mirror, self.root, self.triedb)
         t._buffer = dict(self._buffer)
         t._preview_root = self._preview_root
+        t.expected_root = self.expected_root
         return t
 
     def preimages(self) -> Dict[bytes, bytes]:

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..fault import FailpointError, failpoint
@@ -75,7 +76,9 @@ class ResidentAccountMirror:
                  executor=None, base_key: Optional[bytes] = None,
                  device_timeout: Optional[float] = None,
                  cpu_threads: Optional[int] = None,
-                 prefer_host: Optional[bool] = None):
+                 prefer_host: Optional[bool] = None,
+                 pipeline_depth: int = 0,
+                 template_residency: bool = False):
         import os
 
         if cpu_threads is None or int(cpu_threads) <= 0:
@@ -117,6 +120,35 @@ class ResidentAccountMirror:
 
             executor = ResidentExecutor()
         self.ex = executor  # None in host mode unless the caller passed one
+        # cross-commit device pipelining: up to [pipeline_depth] verified
+        # commits may stay IN FLIGHT on the device, each optimistically
+        # recorded under the header root it was dispatched against (the
+        # chain threads it through verify/preview) and settled — device
+        # root compared against that header root — at the next drain
+        # point: accept, reject, reorg/branch switch, spot-check, export,
+        # window refill, or host takeover. 0 = every commit synchronizes
+        # before verify returns (the pre-pipelining behavior).
+        self.pipeline_depth = max(0, int(pipeline_depth or 0))
+        # template residency: planned-path semantics (the host digest
+        # cache re-absorbs every commit's digests, so root()/export work
+        # per commit and takeover needs no full rehash) at resident-path
+        # transfer cost (device keeps arenas/store; uploads carry only
+        # fresh leaf content). The per-commit absorb IS a device sync,
+        # so it excludes pipelining.
+        self.template = bool(template_residency) and not self.host_mode
+        if self.template:
+            self.pipeline_depth = 0
+        if self.ex is not None:
+            self.ex.pipeline_depth = self.pipeline_depth
+        # in-flight pipelined commits, DISPATCH order — always a
+        # contiguous suffix of _applied (dispatch happens only at the
+        # head; every branch switch drains first).
+        # guarded-by: _lock
+        self._inflight: List[dict] = []
+        # 1 - (time blocked at drain / wall since dispatch) of the most
+        # recently drained commit — the overlap the pipeline actually
+        # bought (0.0 when serial or never pipelined)
+        self.last_overlap_fraction = 0.0
         # chain hook fired (under the mirror lock) when a device wedge
         # forces the one-way host takeover; receives the reason string.
         # Must not call back into mirror methods or take chainmu.
@@ -175,13 +207,16 @@ class ResidentAccountMirror:
                 if self.host_mode:
                     return self.trie.commit_cpu(threads=self._cpu_threads)
                 try:
+                    if self.template:
+                        return self.trie.commit_template(
+                            self.ex, self.device_timeout)
                     return self.trie.commit_resident_timed(
                         self.ex, self.device_timeout)
                 except DeviceWedgedError as e:
                     self._take_over_host(str(e))
                     return self.trie.commit_cpu(threads=self._cpu_threads)
 
-    def _take_over_host(self, why: str) -> None:
+    def _take_over_host(self, why: str) -> None:  # guarded-by: _lock
         """One-way device -> host switch: rebuild the full host digest
         cache (the device store is unreachable) and degrade the next
         export to a full image. The mirror keeps ALL state — records,
@@ -199,6 +234,7 @@ class ResidentAccountMirror:
             "host: full rehash of %d nodes, then CPU-resident commits",
             why, self.trie.num_nodes)
         self.host_mode = True
+        self.template = False  # host commits absorb by construction
         self.trie.rehash_host(threads=self._cpu_threads)
         # the export delta marks predate the takeover; write a full
         # image at the next interval so disk supersedes any device-era
@@ -213,7 +249,152 @@ class ResidentAccountMirror:
 
                 count_drop("state/resident/takeover_hook_error")
 
-    @_locked
+    # ---- cross-commit device pipelining ----------------------------------
+
+    def _pipelining(self) -> bool:
+        return (self.pipeline_depth > 0 and not self.host_mode
+                and not self.template and self.ex is not None)
+
+    def _commit_dispatch(self, key: bytes, expected: bytes,  # guarded-by: _lock
+                         updates) -> bytes:
+        """Dispatch this commit's device program WITHOUT waiting for its
+        root; the entry settles at the next drain point. The caller has
+        already opened the scope and applied [updates]; [expected] is
+        the header root this commit is optimistically recorded under."""
+        from ..native.mpt import DeviceWedgedError
+
+        try:
+            resolve = self.trie.commit_resident_dispatch(
+                self.ex, self.device_timeout)
+        except DeviceWedgedError as e:
+            # wedge at dispatch: the current block's open scope sits on
+            # top of the window's scopes — fold it out of the way, land
+            # the window on the host, then re-apply and commit serially
+            self.trie.rollback()
+            self._drain_on_host(str(e))
+            self.trie.checkpoint()
+            self.trie.update(updates)
+            return self.trie.commit_cpu(threads=self._cpu_threads)
+        self._inflight.append({
+            "key": key, "expected": expected, "resolve": resolve,
+            "t_dispatch": time.monotonic()})
+        return expected
+
+    def _drain_pipeline(self, leave: int = 0,  # guarded-by: _lock
+                        upto: Optional[bytes] = None) -> None:
+        """Resolve in-flight pipelined commits in dispatch order,
+        comparing each device root against the header root it was
+        recorded under. leave: stop once at most this many entries
+        remain (window refill before the next dispatch); upto: stop
+        once this block's entry has settled (accept only needs its own
+        prefix). A device wedge mid-drain lands the WHOLE window on the
+        host bit-exactly; a root mismatch rewinds the offending commit
+        and raises MirrorError."""
+        from ..native.mpt import DeviceWedgedError
+
+        if upto is not None and not any(
+                e["key"] == upto for e in self._inflight):
+            return
+        while len(self._inflight) > max(0, leave):
+            ent = self._inflight.pop(0)
+            t0 = time.monotonic()
+            try:
+                root = ent["resolve"]()
+            except DeviceWedgedError as e:
+                self._inflight.insert(0, ent)
+                self._drain_on_host(str(e))
+                return
+            self._note_overlap(ent, t0)
+            if root != ent["expected"]:
+                self._pipeline_diverged(ent, root)
+            if upto is not None and ent["key"] == upto:
+                return
+
+    def _note_overlap(self, ent: dict, t0: float) -> None:  # guarded-by: _lock
+        """Record how much of this commit's device time the pipeline hid
+        (1 = the drain found it already finished; 0 = fully serial)."""
+        from ..metrics import default_registry
+
+        now = time.monotonic()
+        wall = now - ent["t_dispatch"]
+        blocked = now - t0
+        frac = 0.0 if wall <= 0 else max(0.0, 1.0 - blocked / wall)
+        self.last_overlap_fraction = frac
+        default_registry.gauge("resident/overlap_fraction").update(frac)
+
+    def _drain_on_host(self, why: str) -> None:  # guarded-by: _lock
+        """A device wedge surfaced while the pipeline window was
+        non-empty: take over on the host, then recompute every in-flight
+        commit's root there — rewind through the window's scopes and
+        replay each batch with a serial host commit, comparing against
+        the header root it was recorded under. Bit-exact: the host
+        hasher is the oracle the device was checked against all along
+        (the PR 6 soft landing, now window-deep)."""
+        window, self._inflight = list(self._inflight), []
+        self._take_over_host(why)
+        for _ in window:
+            self._applied.pop()
+            self.trie.rollback()
+            self._dirty_since_export = True
+        for i, ent in enumerate(window):
+            self.trie.checkpoint()
+            self.trie.update(self._batch[ent["key"]])
+            self._dirty_since_export = True
+            root = self.trie.commit_cpu(threads=self._cpu_threads)
+            if root != ent["expected"]:
+                # the host oracle disagrees with the recorded header
+                # root: the BLOCK was wrong, not the device — drop it
+                # and everything stacked on it
+                self.trie.rollback()
+                for e in window[i:]:
+                    self._forget(e["key"])
+                self._prune_orphans()
+                from ..metrics import default_registry
+
+                default_registry.counter(
+                    "state/resident/pipeline_divergences").inc(1)
+                raise MirrorError(
+                    "host recompute of in-flight block "
+                    f"{ent['key'].hex()[:8]} does not match its header "
+                    "root")
+            self._applied.append(ent["key"])
+
+    def _pipeline_diverged(self, ent: dict, got: bytes) -> None:  # guarded-by: _lock
+        """A drained pipelined commit's device root differs from the
+        header root it was optimistically recorded under. Rewind the
+        offending commit and every applied descendant (they built on a
+        wrong state), forget the rest of the window, and raise — the
+        chain adapter's fallback recomputes TRUE roots on the disk
+        path, so a bad block still fails consensus and the periodic
+        spot-check quarantines a genuinely corrupt device."""
+        from ..log import get_logger
+        from ..metrics import default_registry
+
+        default_registry.counter(
+            "state/resident/pipeline_divergences").inc(1)
+        stale, self._inflight = list(self._inflight), []
+        key = ent["key"]
+        if key in self._applied:
+            idx = self._applied.index(key)
+            while len(self._applied) > idx:
+                dropped = self._applied.pop()
+                self.trie.rollback()
+                self._dirty_since_export = True
+                self._forget(dropped)
+        else:
+            self._forget(key)
+        for e in stale:
+            self._forget(e["key"])
+        self._prune_orphans()
+        get_logger("state").error(
+            "pipelined resident commit diverged at %s: device %s != "
+            "header %s — rewound %d in-flight block(s)",
+            key.hex()[:8], got.hex()[:16], ent["expected"].hex()[:16],
+            1 + len(stale))
+        raise MirrorError(
+            f"pipelined commit root mismatch at {key.hex()[:8]}")
+
+    @_locked  # guarded-by: _lock
     def spot_check(self) -> bool:
         """Periodic device-vs-host cross-check (chain knob
         resident_spot_check_interval): verify the device-resident image
@@ -244,16 +425,36 @@ class ResidentAccountMirror:
             return False  # chaos-forced divergence
         if self.host_mode or self.trie.num_nodes == 0:
             return True  # the host oracle already computed these roots
+        # the check must not race an in-flight pipelined window: its
+        # store readback would observe commits whose roots were never
+        # compared, mis-attributing a divergence to "the device" when a
+        # specific block was wrong. Settle the window first (per-block
+        # attribution), then cross-check the settled image.
+        # guarded-by: _lock (the decorator serializes against dispatch)
         try:
-            dev_root = self.trie.commit_resident_timed(
-                self.ex, self.device_timeout)
-            if self.device_timeout is None:
-                store_np = np.asarray(self.ex.store)
+            self._drain_pipeline()
+        except MirrorError:
+            default_registry.counter(
+                "state/resident/spot_check_failures").inc(1)
+            return False
+        if self.host_mode:
+            return True  # the drain wedged and took over on the host
+        try:
+            if self.template:
+                # template commits absorb every digest as they go — the
+                # host cache is already the device image; just settle
+                dev_root = self.trie.commit_template(
+                    self.ex, self.device_timeout)
             else:
-                store_np = _run_with_watchdog(
-                    lambda: np.asarray(self.ex.store),
-                    self.device_timeout, "spot-check store readback")
-            self.trie.absorb_store(store_np)
+                dev_root = self.trie.commit_resident_timed(
+                    self.ex, self.device_timeout)
+                if self.device_timeout is None:
+                    store_np = np.asarray(self.ex.store)
+                else:
+                    store_np = _run_with_watchdog(
+                        lambda: np.asarray(self.ex.store),
+                        self.device_timeout, "spot-check store readback")
+                self.trie.absorb_store(store_np)
         except DeviceWedgedError as e:
             # not a divergence: the ladder's failure mode. Take over like
             # any wedged commit; the host root is authoritative now.
@@ -278,12 +479,20 @@ class ResidentAccountMirror:
 
     # ---- lifecycle -------------------------------------------------------
 
-    @_locked
+    @_locked  # guarded-by: _lock
     def verify(self, parent_hash: bytes, block_hash: bytes,
-               updates: Sequence[Tuple[bytes, bytes]]) -> bytes:
+               updates: Sequence[Tuple[bytes, bytes]],
+               expected_root: Optional[bytes] = None) -> bytes:
         """Apply [updates] on top of [parent_hash]'s state and return the
         resulting state root. Saves the batch so later branch switches
-        can replay it."""
+        can replay it.
+
+        When [expected_root] (the header root) is given and pipelining
+        is on, the commit is DISPATCHED but not synchronized: the
+        expected root is recorded and returned optimistically, and the
+        device root is compared against it at the next drain point —
+        host planning of the next block overlaps this block's device
+        execution."""
         if parent_hash == self.ANON:
             parent_hash = self._promote_anon()
         if parent_hash not in self._roots:
@@ -298,6 +507,8 @@ class ResidentAccountMirror:
         updates = list(updates)
         # a matching anonymous preview (the miner's block-under-
         # construction) is this block's state already applied: adopt it
+        # (an in-flight ANON dispatch is adopted with it — the entry is
+        # renamed and settles under the block's name)
         if (self.ANON in self._roots
                 and self._parent.get(self.ANON) == parent_hash
                 and self._batch.get(self.ANON) == updates
@@ -308,6 +519,19 @@ class ResidentAccountMirror:
         self._drop_anon()
         if self._applied[-1] != parent_hash:
             self._switch_to(parent_hash)
+        if expected_root is not None and self._pipelining():
+            # refill the bounded window, then dispatch without waiting
+            self._drain_pipeline(leave=self.pipeline_depth - 1)
+        if expected_root is not None and self._pipelining():
+            # (re-checked: a wedge mid-drain may have landed us on host)
+            self.trie.checkpoint()
+            self.trie.update(updates)
+            root = self._commit_dispatch(block_hash, expected_root,
+                                         updates)
+            self._dirty_since_export = True
+            self._record(block_hash, parent_hash, updates, root)
+            return root
+        self._drain_pipeline()
         self.trie.checkpoint()
         self.trie.update(updates)
         root = self._commit_root()
@@ -315,14 +539,20 @@ class ResidentAccountMirror:
         self._record(block_hash, parent_hash, updates, root)
         return root
 
-    @_locked
+    @_locked  # guarded-by: _lock
     def preview(self, parent_hash: bytes,
-                updates: Sequence[Tuple[bytes, bytes]]) -> bytes:
+                updates: Sequence[Tuple[bytes, bytes]],
+                expected_root: Optional[bytes] = None) -> bytes:
         """Compute the root [updates] would produce on top of
         [parent_hash] WITHOUT naming a block — the miner's path, where
         the block hash depends on this root. The state stays applied as
         the single anonymous head; the next verify with the same
-        parent+batch adopts it for free, anything else rewinds it."""
+        parent+batch adopts it for free, anything else rewinds it.
+
+        [expected_root] pipelines exactly like verify(): the chain's
+        validate phase previews with the header root in hand, the later
+        verify adopts the in-flight dispatch — one device program per
+        block, settled at the next drain point."""
         if parent_hash == self.ANON:
             parent_hash = self._promote_anon()
         if parent_hash not in self._roots:
@@ -337,6 +567,17 @@ class ResidentAccountMirror:
         self._drop_anon()
         if self._applied[-1] != parent_hash:
             self._switch_to(parent_hash)
+        if expected_root is not None and self._pipelining():
+            self._drain_pipeline(leave=self.pipeline_depth - 1)
+        if expected_root is not None and self._pipelining():
+            self.trie.checkpoint()
+            self.trie.update(updates)
+            root = self._commit_dispatch(self.ANON, expected_root,
+                                         updates)
+            self._dirty_since_export = True
+            self._record(self.ANON, parent_hash, updates, root)
+            return root
+        self._drain_pipeline()
         self.trie.checkpoint()
         self.trie.update(updates)
         root = self._commit_root()
@@ -349,7 +590,7 @@ class ResidentAccountMirror:
     # recent blocks (the reference's dirty forest is similarly bounded)
     MAX_SIDE_RECORDS = 512
 
-    def _record(self, key: bytes, parent: bytes,
+    def _record(self, key: bytes, parent: bytes,  # guarded-by: _lock
                 batch: List[Tuple[bytes, bytes]], root: bytes) -> None:
         self._parent[key] = parent
         self._batch[key] = batch
@@ -389,7 +630,13 @@ class ResidentAccountMirror:
         self._rename_anon(root)
         return root
 
-    def _rename_anon(self, block_hash: bytes) -> None:
+    def _rename_anon(self, block_hash: bytes) -> None:  # guarded-by: _lock
+        # an in-flight ANON dispatch is adopted with the record: rename
+        # its entry BEFORE _forget (which drops entries by key) so it
+        # settles under the block's name at the next drain
+        for e in self._inflight:
+            if e["key"] == self.ANON:
+                e["key"] = block_hash
         root = self._roots[self.ANON]
         parent = self._parent[self.ANON]
         batch = self._batch[self.ANON]
@@ -405,7 +652,7 @@ class ResidentAccountMirror:
         self._roots[block_hash] = root
         self._by_root.setdefault(root, []).append(block_hash)
 
-    def _drop_anon(self) -> None:
+    def _drop_anon(self) -> None:  # guarded-by: _lock
         if self.ANON not in self._roots:
             return
         if self.ANON in self._applied:
@@ -418,13 +665,18 @@ class ResidentAccountMirror:
                     self._forget(dropped)
         self._forget(self.ANON)
 
-    @_locked
+    @_locked  # guarded-by: _lock
     def accept(self, block_hash: bytes) -> None:
         """Finalize a block. Scopes of finalized history deeper than the
         tip buffer flush (the common linear-chain steady state keeps a
         rolling TIP_BUFFER-deep readable window)."""
         if block_hash not in self._roots:
             raise MirrorError("accepting a block the mirror never saw")
+        # settle the accepted block's dispatch (and everything before
+        # it) BEFORE finality marks it: a root that never matched its
+        # header must not finalize. Later in-flight siblings keep
+        # overlapping.
+        self._drain_pipeline(upto=block_hash)
         self._accepted.add(block_hash)
         self._maybe_flush()
 
@@ -433,7 +685,7 @@ class ResidentAccountMirror:
     # buffer (core/state_manager.go:189+ / TIP_BUFFER_SIZE)
     TIP_BUFFER = 32
 
-    def _maybe_flush(self) -> None:
+    def _maybe_flush(self) -> None:  # guarded-by: _lock
         # the finalized PREFIX of the stack (base + contiguous accepted
         # blocks; anything above can still be rejected and must stay
         # rewindable). Scopes deeper than the tip buffer flush; history
@@ -471,7 +723,7 @@ class ResidentAccountMirror:
                     self._forget(h)
                     changed = True
 
-    @_locked
+    @_locked  # guarded-by: _lock
     def reject(self, block_hash: bytes) -> None:
         """Drop a block. If it is applied, rewind through it (consensus
         rejects its applied descendants with it)."""
@@ -481,6 +733,10 @@ class ResidentAccountMirror:
             # not rewind finalized state through them
             raise MirrorError(
                 f"rejecting an ACCEPTED block ({block_hash.hex()[:8]})")
+        # settle any in-flight window before rewinding through it (a
+        # reject mid-pipeline is a reorg: the drain keeps divergence
+        # attribution per-block before scopes are torn down)
+        self._drain_pipeline()
         if block_hash in self._applied:
             idx = self._applied.index(block_hash)
             while len(self._applied) > idx:
@@ -559,7 +815,7 @@ class ResidentAccountMirror:
         raise last_err if last_err is not None else MirrorError(
             "root unreachable")
 
-    def _batch_keys_of(self, k: bytes):
+    def _batch_keys_of(self, k: bytes):  # guarded-by: _lock
         s = self._batch_keys.get(k)
         if s is None:
             b = self._batch.get(k)
@@ -594,7 +850,7 @@ class ResidentAccountMirror:
 
     # ---- interval persistence (disk flush of changed nodes) --------------
 
-    @_locked
+    @_locked  # guarded-by: _lock
     def export_to(self, diskdb, at_block: Optional[bytes] = None,
                   pre_write=None) -> int:
         """Durably write every account-trie node changed since the
@@ -625,6 +881,9 @@ class ResidentAccountMirror:
             # polling eth_getProof per block would otherwise make every
             # call O(total nodes))
             return 0
+        # the on-disk image must only ever contain SETTLED state: drain
+        # the pipeline window before reading the store back
+        self._drain_pipeline()
         if at_block is not None and self._applied[-1] != at_block:
             self._switch_to(at_block)
         if self.trie.num_nodes == 0:
@@ -643,14 +902,21 @@ class ResidentAccountMirror:
             from ..native.mpt import DeviceWedgedError, _run_with_watchdog
 
             try:
-                self.trie.commit_resident_timed(self.ex, self.device_timeout)
-                if self.device_timeout is None:
-                    store_np = np.asarray(self.ex.store)
+                if self.template:
+                    # template commits absorb as they go — no store
+                    # readback, the host cache is already current
+                    self.trie.commit_template(self.ex,
+                                              self.device_timeout)
                 else:
-                    store_np = _run_with_watchdog(
-                        lambda: np.asarray(self.ex.store),
-                        self.device_timeout, "store readback")
-                self.trie.absorb_store(store_np)
+                    self.trie.commit_resident_timed(
+                        self.ex, self.device_timeout)
+                    if self.device_timeout is None:
+                        store_np = np.asarray(self.ex.store)
+                    else:
+                        store_np = _run_with_watchdog(
+                            lambda: np.asarray(self.ex.store),
+                            self.device_timeout, "store readback")
+                    self.trie.absorb_store(store_np)
             except DeviceWedgedError as e:
                 self._take_over_host(str(e))
                 self.trie.commit_cpu(threads=self._cpu_threads)
@@ -677,6 +943,13 @@ class ResidentAccountMirror:
     # ---- branch switching ------------------------------------------------
 
     def _forget(self, block_hash: bytes) -> None:
+        # a forgotten block's in-flight dispatch has nothing left to
+        # settle against (rollback already re-dirtied its paths; the
+        # device program is harmless — the delta-patch scheme tolerates
+        # rolled-back dispatched commits)   # guarded-by: _lock
+        if self._inflight:
+            self._inflight = [e for e in self._inflight
+                              if e["key"] != block_hash]
         root = self._roots.pop(block_hash, None)
         if root is not None:
             keys = self._by_root.get(root)
@@ -692,9 +965,14 @@ class ResidentAccountMirror:
         self._batch_keys.pop(block_hash, None)
         self._accepted.discard(block_hash)
 
-    def _switch_to(self, target: bytes) -> None:
+    def _switch_to(self, target: bytes) -> None:  # guarded-by: _lock
         """Rewind to the nearest applied ancestor of [target], then
         replay the saved batches down to it."""
+        # a branch switch is the pipeline's hard barrier: settle every
+        # in-flight commit before tearing scopes down (the replay-root
+        # compare below would otherwise race unverified dispatches)
+        # guarded-by: _lock (every caller holds it)
+        self._drain_pipeline()
         # ancestry chain of target up to something applied
         chain: List[bytes] = []
         cur = target

@@ -113,6 +113,18 @@ class Config:
     # (the XLA-CPU "device" keccak is ~150x slower than native); true
     # forces host commits, false pins the device path even on CPU
     resident_prefer_host: "bool | str" = "auto"
+    # cross-commit device pipelining depth (0-4; 1-2 recommended): up to
+    # this many resident commits stay in flight on the device, their
+    # roots optimistically recorded as the header roots and compared at
+    # the next drain point (accept/reject/reorg/spot-check/export) —
+    # host planning of block k+1 overlaps device execution of block k.
+    # 0 = every commit synchronizes before verify returns
+    resident_pipeline_depth: int = 0
+    # template residency: per-commit device->host digest absorb keeps
+    # the host cache warm (root/export always valid, instant takeover)
+    # while the device keeps row arenas + digest store resident, so
+    # uploads carry only fresh leaf content. Excludes pipelining
+    resident_template_residency: bool = False
     # native CPU hasher worker threads (plan execute + batch keccak);
     # 0 = auto (env CORETH_TPU_CPU_THREADS, else min(16, cores))
     cpu_threads: int = 0
@@ -258,6 +270,14 @@ class Config:
         if self.cpu_threads < 0:
             raise ValueError(
                 f"cpu-threads must be >= 0 (got {self.cpu_threads})")
+        if not (0 <= self.resident_pipeline_depth <= 4):
+            raise ValueError(
+                f"resident-pipeline-depth must be in [0, 4] "
+                f"(got {self.resident_pipeline_depth})")
+        if self.resident_template_residency not in (True, False):
+            raise ValueError(
+                f"resident-template-residency must be a boolean "
+                f"(got {self.resident_template_residency!r})")
         if not (0 <= self.evm_parallel_workers <= 64):
             raise ValueError(
                 f"evm-parallel-workers must be in [0, 64] "

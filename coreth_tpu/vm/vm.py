@@ -215,6 +215,9 @@ class VM:
                 device_promote_after=full.device_promote_after,
                 resident_spot_check_interval=(
                     full.resident_spot_check_interval),
+                resident_pipeline_depth=full.resident_pipeline_depth,
+                resident_template_residency=(
+                    full.resident_template_residency),
                 tail_join_timeout=full.tail_join_timeout,
                 state_backend=full.state_backend,
                 shadow_check_interval=full.shadow_check_interval,
