@@ -521,10 +521,12 @@ def _flight_attribution(recs):
         out["overlap_fraction_mean"] = round(
             sum(overlaps) / len(overlaps), 4)
         out["overlap_fraction_max"] = round(max(overlaps), 4)
+    # always emitted: a host-mode leg moves no bytes over the link and
+    # must say so explicitly (0.0) — a ragged key set here makes the
+    # cross-leg comparison average over different columns
     h2d = counters.get("resident/h2d_bytes", 0)
-    if h2d:
-        out["h2d_mb"] = round(h2d / 1e6, 2)
-        out["h2d_bytes_per_block"] = int(h2d / max(len(recs), 1))
+    out["h2d_mb"] = round(h2d / 1e6, 2)
+    out["h2d_bytes_per_block"] = int(h2d / max(len(recs), 1))
     for k in sorted(phases):
         if phases[k] > 0:
             out["chain_" + k + "_s"] = round(phases[k], 4)

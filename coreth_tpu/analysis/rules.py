@@ -363,11 +363,19 @@ CTYPES_ALLOC = {"ctypes.create_string_buffer", "ctypes.create_unicode_buffer",
 # Observability in a hot path must go through the gated helpers (they are
 # no-ops when tracing/metrics are off); constructing/looking-up a metric
 # or span object per call defeats the gate and allocates in the hot loop.
-OBSERVABILITY_ALLOWED = {"phase_timer", "expensive_timer", "span"}
+OBSERVABILITY_ALLOWED = {"phase_timer", "expensive_timer", "span", "mint"}
 OBSERVABILITY_FLAGGED = {
     "timer", "histogram", "meter", "get_or_register_timer",
     "get_or_register_meter", "get_or_register_gauge", "Timer", "Histogram",
     "Meter", "Span", "Tracer", "start_span",
+}
+# Every call that takes a metric/span NAME as an argument: an f-string
+# there allocates a fresh string per call AND defeats the registry's
+# name-keyed lookup — even through the gated helpers. Trace-id formatting
+# belongs in tracectx.mint (gated, %-formatted, off the hot path).
+OBSERVABILITY_NAME_CALLS = OBSERVABILITY_ALLOWED | OBSERVABILITY_FLAGGED | {
+    "counter", "gauge", "observe_slo", "count_drop",
+    "get_or_register_counter",
 }
 
 
@@ -420,6 +428,12 @@ class HotPathPurityRule(Rule):
             return (f"allocates a ctypes buffer per call (`{name}`) — "
                     f"hoist it out of the hot loop")
         last = name.rsplit(".", 1)[-1]
+        if last in OBSERVABILITY_NAME_CALLS and any(
+                isinstance(a, ast.JoinedStr) for a in node.args):
+            return (f"builds a metric/span name with an f-string per call "
+                    f"(`{name}`) inside a hot path — hoist the formatted "
+                    f"name out of the loop; trace ids come from the gated "
+                    f"tracectx.mint helper, not inline formatting")
         if last in OBSERVABILITY_FLAGGED and last not in OBSERVABILITY_ALLOWED:
             return (f"constructs a metric/span per call (`{name}`) inside a "
                     f"hot path — hoist the registry lookup to module scope, "

@@ -1,0 +1,4 @@
+"""Bench-artifact tooling: the trajectory sentinel that turns the
+per-round BENCH_*/BENCH_SUITE_* artifacts into a managed time series
+(see trajectory.py). Pure stdlib — importable without jax/numpy so
+tools/lint.sh can run it anywhere the repo checks out."""

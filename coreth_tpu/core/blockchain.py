@@ -163,6 +163,9 @@ class CacheConfig:
     # shadow canonical-rebuild spot check every K commits (bintrie root
     # re-folded from scratch vs the incremental root); 0 disables
     shadow_check_interval: int = 16
+    # block-insert SLO budget (seconds): inserts slower than this are
+    # auto-captured into the trace ring (debug_traceRequest); 0 disables
+    insert_slo_budget: float = 0.0
 
 
 # counter/timer families snapshotted around each insert so the flight
@@ -850,6 +853,8 @@ class BlockChain:
 
     def _insert_block(self, block: Block, writes: bool) -> None:
         from ..metrics import default_registry as _metrics
+        from ..metrics import observe_slo as _observe_slo
+        from ..metrics import tracectx as _tracectx
 
         insert_timer = _metrics.timer("chain/block/inserts")
         header = block.header
@@ -857,21 +862,33 @@ class BlockChain:
         if parent is None:
             raise ChainError("unknown ancestor")
 
+        # one trace per insert, minted at entry like the RPC admission
+        # point: phase spans collect under it and the flight record keys
+        # back to it, so a slow block is attributable end-to-end
+        ctx = _tracectx.begin("insert")
+
         # flight record for this insert: phases fill as the block moves
-        # through the pipeline; counter deltas are computed at the end
+        # through the pipeline; counter deltas are computed at the end.
+        # `parallel` starts present (empty) so host-fallback and
+        # failed-before-execute records are never ragged
         rec: dict = {
             "number": block.number,
             "hash": block.hash(),
             "txs": len(block.transactions),
             "gas_used": 0,
             "phases": {},
+            "parallel": {},
             "writes": writes,
+            "trace_id": ctx.trace_id if ctx is not None else None,
         }
         self._insert_rec = rec  # single writer: inserts hold chainmu
         counters0 = {n: _metrics.counter(n).count() for n in _FLIGHT_COUNTERS}
         timers0 = {n: _metrics.timer(n).total() for n in _FLIGHT_TIMERS}
         phases = rec["phases"]
 
+        t0 = time.monotonic()
+        tscope = _tracectx.scope(ctx)
+        tscope.__enter__()
         insert_span = _span("chain/insert", number=block.number,
                             txs=len(block.transactions))
         insert_span.__enter__()
@@ -880,6 +897,8 @@ class BlockChain:
                                 insert_timer, _metrics)
         except BaseException as e:
             insert_span.__exit__(type(e), e, e.__traceback__)
+            if ctx is not None:
+                ctx.meta["error"] = type(e).__name__
             raise
         else:
             insert_span.__exit__(None, None, None)
@@ -903,6 +922,21 @@ class BlockChain:
                 # lands in — good enough for the A/B artifact)
                 rec["resident"]["overlap_fraction"] = round(
                     mirror.last_overlap_fraction, 4)
+            elapsed = time.monotonic() - t0
+            _observe_slo("slo/chain/insert", elapsed,
+                         ctx.trace_id if ctx is not None else None)
+            if ctx is not None:
+                ctx.meta["number"] = block.number
+                ctx.meta["txs"] = len(block.transactions)
+                budget = self.cache_config.insert_slo_budget
+                if "error" in ctx.meta:
+                    ctx.meta["outcome"] = "insert_failed"
+                    _tracectx.capture(ctx, "insert_failed")
+                elif 0 < budget < elapsed:
+                    ctx.meta["outcome"] = "slow"
+                    ctx.meta["over_slo_budget_s"] = budget
+                    _tracectx.capture(ctx, "slow")
+            tscope.__exit__(None, None, None)
 
     def _insert_phases(self, block: Block, header: Header, parent: Header,
                        writes: bool, rec: dict, phases: Dict[str, float],

@@ -48,20 +48,48 @@ class Gauge:
             return self._v
 
 
-class Histogram:
-    """Sampling histogram with percentile queries."""
+# fixed latency buckets for SLO histograms (seconds); chosen to straddle
+# the cheap-lane (tens of ms) and expensive-lane (seconds) budgets
+DEFAULT_SLO_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                       1.0, 2.5, 5.0, 10.0)
 
-    def __init__(self, reservoir: int = 1028):
+
+class Histogram:
+    """Sampling histogram with percentile queries.  With `buckets` set it
+    additionally keeps fixed-bucket counts plus one exemplar (trace id +
+    observed value) per bucket, and exports as a real Prometheus
+    histogram family instead of a summary."""
+
+    def __init__(self, reservoir: int = 1028, buckets=None):
         self._samples: List[float] = []
         self._reservoir = reservoir
         self._count = 0
         self._sum = 0.0
         self._lock = threading.Lock()
+        if buckets:
+            self._buckets: Optional[Tuple[float, ...]] = tuple(
+                sorted(float(b) for b in buckets))
+            self._bucket_counts = [0] * len(self._buckets)
+            # per finite bucket: latest (value, trace_id) landing in it
+            self._exemplars: List[Optional[Tuple[float, str]]] = (
+                [None] * len(self._buckets))
+        else:
+            self._buckets = None
+            self._bucket_counts = []
+            self._exemplars = []
 
-    def update(self, v: float) -> None:
+    def update(self, v: float, exemplar: Optional[str] = None) -> None:
         with self._lock:
             self._count += 1
             self._sum += v
+            if self._buckets is not None:
+                import bisect
+
+                i = bisect.bisect_left(self._buckets, v)
+                if i < len(self._buckets):
+                    self._bucket_counts[i] += 1
+                    if exemplar:
+                        self._exemplars[i] = (v, exemplar)
             if len(self._samples) < self._reservoir:
                 self._samples.append(v)
             else:
@@ -70,6 +98,34 @@ class Histogram:
                 i = random.randrange(self._count)
                 if i < self._reservoir:
                     self._samples[i] = v
+
+    def bucket_bounds(self) -> Optional[Tuple[float, ...]]:
+        return self._buckets
+
+    def buckets(self) -> List[Tuple[float, int]]:
+        """Cumulative (upper_bound, count<=bound) pairs; the implicit
+        +Inf bucket is the total count (``count()``)."""
+        if self._buckets is None:
+            return []
+        with self._lock:
+            out: List[Tuple[float, int]] = []
+            cum = 0
+            for le, n in zip(self._buckets, self._bucket_counts):
+                cum += n
+                out.append((le, cum))
+            return out
+
+    def exemplars(self) -> Dict[str, Dict[str, object]]:
+        """{le_label: {"trace_id": ..., "value": ...}} for buckets that
+        have captured one."""
+        if self._buckets is None:
+            return {}
+        with self._lock:
+            out: Dict[str, Dict[str, object]] = {}
+            for le, ex in zip(self._buckets, self._exemplars):
+                if ex is not None:
+                    out[_fmt_value(le)] = {"value": ex[0], "trace_id": ex[1]}
+            return out
 
     def count(self) -> int:
         return self._count
@@ -217,8 +273,9 @@ class Registry:
     def gauge(self, name: str) -> Gauge:
         return self._get_or_register(name, Gauge)
 
-    def histogram(self, name: str) -> Histogram:
-        return self._get_or_register(name, Histogram)
+    def histogram(self, name: str, buckets=None) -> Histogram:
+        return self._get_or_register(
+            name, lambda: Histogram(buckets=buckets))
 
     def meter(self, name: str) -> Meter:
         return self._get_or_register(name, Meter)
@@ -280,8 +337,30 @@ class Registry:
                         f"coreth_tpu timer {name} (seconds)",
                         m.hist.percentiles(_QUANTILES), m.total(), m.count())
             elif isinstance(m, Histogram):
-                summary(fam, f"coreth_tpu histogram {name}",
-                        m.percentiles(_QUANTILES), m.sum(), m.count())
+                if m.bucket_bounds() is not None:
+                    # real histogram family: cumulative le buckets, the
+                    # +Inf bucket equal to _count, then _sum/_count.
+                    # Exemplars ride as comment lines (text-format 0.0.4
+                    # has no inline exemplar syntax; any scraper skips
+                    # comments, and our --check validates them).
+                    samples: List[Tuple[str, tuple, object]] = []
+                    for le, cum in m.buckets():
+                        samples.append((fam + "_bucket",
+                                        (("le", _fmt_value(le)),), cum))
+                    samples.append((fam + "_bucket", (("le", "+Inf"),),
+                                    m.count()))
+                    samples.append((fam + "_sum", (), m.sum()))
+                    samples.append((fam + "_count", (), m.count()))
+                    family(fam, "histogram",
+                           f"coreth_tpu slo histogram {name}", samples)
+                    for le_label, ex in sorted(m.exemplars().items()):
+                        lines.append(
+                            f'# EXEMPLAR {fam}_bucket{{le="{le_label}"}} '
+                            f"trace_id={ex['trace_id']} "
+                            f"value={_fmt_value(ex['value'])}")
+                else:
+                    summary(fam, f"coreth_tpu histogram {name}",
+                            m.percentiles(_QUANTILES), m.sum(), m.count())
         return "\n".join(lines) + "\n"
 
     def marshal(self) -> Dict[str, dict]:
@@ -307,6 +386,10 @@ class Registry:
                 out[name] = {"type": "histogram", "count": m.count(),
                              "sum": m.sum(), "mean": m.mean(),
                              "p50": p50, "p90": p90, "p99": p99}
+                if m.bucket_bounds() is not None:
+                    out[name]["buckets"] = {
+                        _fmt_value(le): cum for le, cum in m.buckets()}
+                    out[name]["exemplars"] = m.exemplars()
         return out
 
 
@@ -357,6 +440,17 @@ def phase_timer(name: str, registry: Optional[Registry] = None):
     if not enabled:
         return _NULL_CTX
     return (registry or default_registry).timer(name).time()
+
+
+def observe_slo(name: str, seconds: float, exemplar: Optional[str] = None,
+                registry: Optional[Registry] = None) -> None:
+    """Record one latency observation into a fixed-bucket SLO histogram
+    (created on first use with DEFAULT_SLO_BUCKETS), optionally attaching
+    a trace-id exemplar to the bucket the observation lands in."""
+    if not enabled:
+        return
+    (registry or default_registry).histogram(
+        name, buckets=DEFAULT_SLO_BUCKETS).update(seconds, exemplar=exemplar)
 
 
 def expensive_timer(name: str, registry: Optional[Registry] = None):

@@ -27,6 +27,9 @@ _LABEL_RE = re.compile(r'^([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"$')
 _HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) (.*)$")
 _TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
                       r"(counter|gauge|summary|histogram|untyped)$")
+_EXEMPLAR_RE = re.compile(
+    r'^# EXEMPLAR ([a-zA-Z_:][a-zA-Z0-9_:]*)_bucket\{le="([^"]+)"\} '
+    r"trace_id=(\S+) value=(\S+)$")
 
 # suffixes a sample may add to its family name, by family type
 _FAMILY_SUFFIXES = {
@@ -53,13 +56,21 @@ def validate_exposition(text: str) -> List[str]:
     names are legal, HELP/TYPE declared once per family and before its
     samples, every sample belongs to a declared family, summary
     quantiles are float labels with monotone values, summaries carry
-    _sum and _count, counters are finite and non-negative."""
+    _sum and _count, counters are finite and non-negative, histogram
+    buckets carry a parseable `le` label with cumulative-monotone counts
+    and a `+Inf` bucket equal to `_count`, and `# EXEMPLAR` comment lines
+    reference a declared histogram bucket with a value inside it."""
     errors: List[str] = []
     types: Dict[str, str] = {}
     helps: Dict[str, str] = {}
     # family -> [(quantile, value)] for monotonicity; family -> suffixes seen
     quantiles: Dict[str, List[Tuple[float, float]]] = {}
     suffixes_seen: Dict[str, set] = {}
+    # family -> {le_label: cumulative_count}; family -> {_sum/_count: value}
+    buckets: Dict[str, Dict[str, float]] = {}
+    hist_scalars: Dict[str, Dict[str, float]] = {}
+    # (lineno, family, le_label, trace_id, raw_value) for post-pass checks
+    exemplars: List[Tuple[int, str, str, str, str]] = []
 
     def owning_family(sample: str) -> Optional[Tuple[str, str]]:
         best = None
@@ -88,6 +99,14 @@ def validate_exposition(text: str) -> List[str]:
                 types[fam] = mt.group(2)
             elif line.startswith("# HELP") or line.startswith("# TYPE"):
                 errors.append(f"line {lineno}: malformed HELP/TYPE: {line!r}")
+            elif line.startswith("# EXEMPLAR"):
+                me = _EXEMPLAR_RE.match(line)
+                if me:
+                    exemplars.append((lineno, me.group(1), me.group(2),
+                                      me.group(3), me.group(4)))
+                else:
+                    errors.append(
+                        f"line {lineno}: malformed EXEMPLAR: {line!r}")
             continue  # other comments are legal
 
         m = _SAMPLE_RE.match(line)
@@ -120,6 +139,22 @@ def validate_exposition(text: str) -> List[str]:
         if kind == "counter" and not (value >= 0 and math.isfinite(value)):
             errors.append(
                 f"line {lineno}: counter {name} value {rawvalue} invalid")
+        if kind == "histogram":
+            if sfx == "_bucket":
+                le = labels.get("le")
+                if le is None:
+                    errors.append(
+                        f"line {lineno}: histogram bucket {name} missing le")
+                elif _parse_value(le) is None:
+                    errors.append(
+                        f"line {lineno}: unparseable le {le!r} on {name}")
+                elif le in buckets.setdefault(fam, {}):
+                    errors.append(
+                        f"line {lineno}: duplicate bucket le={le} on {name}")
+                else:
+                    buckets[fam][le] = value
+            elif sfx in ("_sum", "_count"):
+                hist_scalars.setdefault(fam, {})[sfx] = value
         if kind == "summary" and sfx == "":
             q = labels.get("quantile")
             if q is None:
@@ -145,6 +180,44 @@ def validate_exposition(text: str) -> List[str]:
         if any(b < a for a, b in zip(values, values[1:])):
             errors.append(f"summary {fam}: quantile values not monotone: "
                           f"{ordered}")
+    for fam, kind in types.items():
+        if kind != "histogram" or fam not in suffixes_seen:
+            continue
+        for want in ("_sum", "_count"):
+            if want not in suffixes_seen[fam]:
+                errors.append(f"histogram {fam}: missing {fam}{want}")
+        bks = buckets.get(fam, {})
+        if not bks:
+            errors.append(f"histogram {fam}: no buckets")
+            continue
+        if "+Inf" not in bks:
+            errors.append(f"histogram {fam}: missing +Inf bucket")
+        ordered_b = sorted(bks.items(), key=lambda kv: _parse_value(kv[0]))
+        counts = [v for _, v in ordered_b]
+        if any(b < a for a, b in zip(counts, counts[1:])):
+            errors.append(f"histogram {fam}: bucket counts not "
+                          f"cumulative-monotone: {ordered_b}")
+        count = hist_scalars.get(fam, {}).get("_count")
+        if count is not None and "+Inf" in bks and bks["+Inf"] != count:
+            errors.append(f"histogram {fam}: +Inf bucket {bks['+Inf']} != "
+                          f"_count {count}")
+    for lineno, fam, le, trace_id, rawv in exemplars:
+        if types.get(fam) != "histogram":
+            errors.append(f"line {lineno}: EXEMPLAR for non-histogram {fam}")
+            continue
+        if le not in buckets.get(fam, {}):
+            errors.append(
+                f"line {lineno}: EXEMPLAR references unknown bucket "
+                f"le={le} on {fam}")
+        v = _parse_value(rawv)
+        bound = _parse_value(le)
+        if v is None or not math.isfinite(v):
+            errors.append(f"line {lineno}: EXEMPLAR bad value {rawv!r}")
+        elif bound is not None and v > bound:
+            errors.append(
+                f"line {lineno}: EXEMPLAR value {rawv} outside le={le}")
+        if not trace_id:
+            errors.append(f"line {lineno}: EXEMPLAR missing trace_id")
     return errors
 
 
@@ -164,6 +237,13 @@ def _synthetic_registry() -> Registry:
     for i in range(200):
         t.update(0.001 * (i + 1))
     r.timer("chain/phase/empty")  # registered but never updated
+    from . import DEFAULT_SLO_BUCKETS
+
+    slo = r.histogram("slo/rpc/eth_call", buckets=DEFAULT_SLO_BUCKETS)
+    for i in range(100):
+        slo.update(0.004 * (i % 30), exemplar="rpc-test-%06x" % i)
+    slo.update(99.0, exemplar="rpc-test-above-top-bucket")
+    r.histogram("slo/chain/insert", buckets=DEFAULT_SLO_BUCKETS)  # empty
     return r
 
 

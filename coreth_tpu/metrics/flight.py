@@ -11,7 +11,14 @@ Each record is a plain dict built by core/blockchain during insert:
      "counters": {name: delta, ...},         # snap + plan-cache + keccak
      "parallel": {"mode": ..., ...},         # optimistic-executor verdict
      "host_mode": bool | None,               # device vs host hashing
+     "trace_id": str | None,                 # insert-… id (tracectx)
      "accepted": bool, "seq": int}
+
+`parallel` starts present-but-empty and `host_mode`/`counters` are
+stamped in the insert's finally block, so host-fallback and
+failed-before-execute records carry the same key set as the happy path
+(`counters["resident/h2d_bytes"]` is an explicit 0 on host-mode
+commits — bench attribution must never average over a ragged set).
 
 The `write` phase is stamped asynchronously by the overlapped insert
 tail; records are shared dicts, so readers see it once the tail worker

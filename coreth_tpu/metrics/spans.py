@@ -29,6 +29,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from . import tracectx as _tracectx
+
 # process-global fast gate: checked (unlocked) on every span() call.
 # Torn reads are harmless — the worst case is one span recorded or
 # skipped around the toggle instant.
@@ -64,6 +66,14 @@ class Span:
         stack = self._tracer._stack()
         if stack:
             self.parent_id = stack[-1].span_id
+        else:
+            # lane handoff: a root span on a worker thread inherits its
+            # parent from the ambient trace context captured at admission,
+            # so parenting survives the thread boundary
+            ctx = _tracectx.current()
+            if ctx is not None:
+                self.parent_id = ctx.parent_span_id
+                self.attrs.setdefault("trace_id", ctx.trace_id)
         stack.append(self)
         self.start = time.monotonic()
         return self
@@ -80,6 +90,18 @@ class Span:
         elif self in stack:
             stack.remove(self)
         self._tracer._commit(self)
+        ctx = _tracectx.current()
+        if ctx is not None:
+            ctx.add_span({
+                "name": self.name,
+                "span_id": self.span_id,
+                "parent_id": self.parent_id,
+                "start_s": self.start,
+                "duration_s": self.duration(),
+                "tid": self.tid,
+                "attrs": {k: v for k, v in self.attrs.items()
+                          if k != "trace_id"},
+            })
         return False
 
     def duration(self) -> float:

@@ -33,6 +33,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..metrics import default_registry
+from ..metrics import tracectx
 from ..utils.deadline import Deadline, DeadlineExceeded, scope as deadline_scope
 
 __all__ = [
@@ -141,6 +142,9 @@ class WorkerPool:
 
     def submit(self, method: str, fn: Callable[[], object]) -> _Future:
         fut = _Future()
+        # capture the admitting thread's trace context so the worker
+        # thread that eventually runs fn inherits it (lane handoff)
+        ctx = tracectx.current()
         with self._lock:
             if self._draining:
                 raise Shed("draining", "server is draining")
@@ -149,12 +153,15 @@ class WorkerPool:
                 threading.Thread(target=self._loop, daemon=True,
                                  name=f"rpc-{self.name}-{self._spawned}").start()
         try:
-            self._q.put_nowait((method, fn, fut))
+            self._q.put_nowait((method, fn, fut, ctx))
         except queue.Full:
             raise Shed(
                 "queue_full",
                 f"{self.name} lane at capacity "
                 f"({self.workers} workers, {self._q.maxsize} queued)")
+        if ctx is not None:
+            ctx.meta["lane"] = self.name
+            ctx.meta["queued_behind"] = self._q.qsize() - 1
         self._g_queue.update(self._q.qsize())
         return fut
 
@@ -163,7 +170,7 @@ class WorkerPool:
             item = self._q.get()
             if item is None:
                 return
-            method, fn, fut = item
+            method, fn, fut, ctx = item
             tid = threading.get_ident()
             with self._lock:
                 self._inflight += 1
@@ -171,7 +178,8 @@ class WorkerPool:
             self._g_queue.update(self._q.qsize())
             self._g_inflight.update(self._inflight)
             try:
-                fut.set(fn())
+                with tracectx.scope(ctx):
+                    fut.set(fn())
             finally:
                 with self._lock:
                     self._inflight -= 1
@@ -212,9 +220,12 @@ class WorkerPool:
             fut.set(ABANDONED)
         while True:  # answer queued-but-never-started requests
             try:
-                method, _fn, fut = self._q.get_nowait()
+                item = self._q.get_nowait()
             except queue.Empty:
                 break
+            if item is None:
+                continue
+            method, _fn, fut, _ctx = item
             abandoned.append(method)
             fut.set(ABANDONED)
         for _ in range(self._spawned):  # release parked workers
@@ -326,10 +337,14 @@ class ServingPolicy:
                  breaker_close_after: int = 3,
                  drain_timeout: float = 5.0,
                  max_connections: int = 128,
-                 ws_notify_queue_size: int = 256):
+                 ws_notify_queue_size: int = 256,
+                 slo_budget: float = 1.0):
         self.max_workers = max_workers
         self.cheap_budget = cheap_budget
         self.expensive_budget = expensive_budget or cheap_budget
+        # completions slower than this (seconds) are auto-captured into
+        # the trace ring even though they succeeded; 0 disables
+        self.slo_budget = slo_budget
         self.batch_limit = batch_limit
         self.body_limit = body_limit
         self.drain_timeout = drain_timeout
@@ -366,6 +381,7 @@ class ServingPolicy:
             drain_timeout=cfg.rpc_drain_timeout,
             max_connections=cfg.rpc_max_connections,
             ws_notify_queue_size=cfg.ws_notify_queue_size,
+            slo_budget=cfg.rpc_slo_budget,
         )
 
     # --- dispatch helpers -------------------------------------------------
@@ -391,6 +407,7 @@ class ServingPolicy:
             "body_limit": self.body_limit,
             "cheap_budget": self.cheap_budget,
             "expensive_budget": self.expensive_budget,
+            "slo_budget": self.slo_budget,
             "drain_timeout": self.drain_timeout,
             "drained": self._drained,
         }

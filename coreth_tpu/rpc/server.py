@@ -20,12 +20,15 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional
 
 from ..fault import failpoint, register
-from ..metrics import default_registry
+from ..metrics import default_registry, observe_slo
+from ..metrics import spans as _spans
+from ..metrics import tracectx
 from ..utils.deadline import Deadline, DeadlineExceeded
 from ..utils.deadline import scope as _deadline_scope
 from .admission import (ABANDONED, LIMIT_EXCEEDED, TIMEOUT_ERROR,
@@ -70,6 +73,13 @@ class RPCServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._ipc_stops: List[Callable[[], None]] = []
         self.policy = policy
+        self._draining = False
+
+    @property
+    def draining(self) -> bool:
+        """True once stop() has begun: /healthz flips to 503 so load
+        balancers route away while in-flight work drains."""
+        return self._draining
 
     # --- registration -----------------------------------------------------
 
@@ -156,6 +166,34 @@ class RPCServer:
             return self._encode_error(
                 req_id, METHOD_NOT_FOUND, f"the method {method} does not exist"
             )
+        # mint the request's trace context at admission: it rides the
+        # lane handoff (WorkerPool.submit captures it), parents worker-side
+        # spans, and stamps every shed/expiry/abandonment answer
+        ctx = None
+        if tracectx.enabled:
+            parent_span_id = None
+            if _spans.enabled:
+                cur = _spans.tracer.current()
+                if cur is not None:
+                    parent_span_id = cur.span_id
+            ctx = tracectx.begin("rpc", parent_span_id)
+            ctx.meta["method"] = method
+        t0 = time.monotonic()
+        with tracectx.scope(ctx):
+            resp = self._dispatch_one(req_id, method, fn, params, meta)
+            elapsed = time.monotonic() - t0
+            observe_slo("slo/rpc/" + method, elapsed,
+                        ctx.trace_id if ctx is not None else None)
+            if ctx is not None and "outcome" not in ctx.meta:
+                policy = self.policy
+                slo = policy.slo_budget if policy is not None else 0.0
+                if 0 < slo < elapsed:
+                    ctx.meta["over_slo_budget_s"] = slo
+                    tracectx.capture(ctx, "slow")
+        return resp
+
+    def _dispatch_one(self, req_id, method, fn, params,
+                      meta: Optional[dict]) -> bytes:
         policy = self.policy
         if policy is None:
             return self._run_handler(req_id, method, fn, params, None)[0]
@@ -166,6 +204,9 @@ class RPCServer:
             # the budget covers queue wait + execution: bounded latency,
             # not just bounded run time
             deadline = Deadline(budget)
+            ctx = tracectx.current()
+            if ctx is not None:
+                ctx.meta["budget_s"] = budget
         if lane is None:
             return self._run_handler(req_id, method, fn, params, deadline)[0]
         return self._dispatch_pooled(req_id, method, fn, params, lane,
@@ -183,7 +224,9 @@ class RPCServer:
                 return self._encode_error(
                     req_id, LIMIT_EXCEEDED,
                     "circuit breaker open: expensive methods are "
-                    "timing out; retry later")
+                    "timing out; retry later",
+                    self._trace_capture("shed", reason="breaker",
+                                        code=LIMIT_EXCEEDED))
             probe = verdict == "probe"
         try:
             fut = lane.submit(
@@ -193,7 +236,9 @@ class RPCServer:
         except Shed as s:
             self._count_shed(method, s.reason, meta)
             code = TIMEOUT_ERROR if s.reason == "draining" else LIMIT_EXCEEDED
-            return self._encode_error(req_id, code, str(s))
+            return self._encode_error(
+                req_id, code, str(s),
+                self._trace_capture("shed", reason=s.reason, code=code))
         # Cooperative handlers answer by their deadline; the wait backstop
         # only catches a handler that never reaches a checkpoint (its
         # worker stays lost until it returns — threads cannot be killed).
@@ -210,11 +255,13 @@ class RPCServer:
             return self._encode_error(
                 req_id, TIMEOUT_ERROR,
                 f"request exceeded its {deadline.budget:g}s budget "
-                f"(handler missed every deadline checkpoint)")
+                f"(handler missed every deadline checkpoint)",
+                self._trace_capture("stuck", code=TIMEOUT_ERROR))
         if value is ABANDONED:
             return self._encode_error(
                 req_id, TIMEOUT_ERROR,
-                "server shut down before the request was served")
+                "server shut down before the request was served",
+                self._trace_capture("abandoned", code=TIMEOUT_ERROR))
         resp, timed_out = value
         if expensive:
             policy.breaker.record(timed_out, probe)
@@ -227,9 +274,7 @@ class RPCServer:
             failpoint("rpc/before_dispatch")
             if is_expensive(method):
                 failpoint("rpc/before_dispatch_expensive")
-            from ..metrics.spans import span
-
-            with span("rpc/" + method):
+            with _spans.span("rpc/" + method):
                 with _deadline_scope(deadline):
                     if deadline is not None:
                         deadline.check()  # shed queue-expired work unrun
@@ -239,7 +284,10 @@ class RPCServer:
                         result = fn(*params)
         except DeadlineExceeded as e:
             default_registry.counter("rpc/timeout").inc()
-            return self._encode_error(req_id, TIMEOUT_ERROR, str(e)), True
+            return self._encode_error(
+                req_id, TIMEOUT_ERROR, str(e),
+                self._trace_capture("deadline_expired",
+                                    code=TIMEOUT_ERROR)), True
         except RPCError as e:
             return self._encode_error(req_id, e.code, str(e), e.data), False
         except TypeError as e:
@@ -249,6 +297,23 @@ class RPCServer:
         return json.dumps(
             {"jsonrpc": "2.0", "id": req_id, "result": result}
         ).encode(), False
+
+    @staticmethod
+    def _trace_capture(outcome: str, reason: Optional[str] = None,
+                       code: Optional[int] = None) -> Optional[dict]:
+        """Capture the calling thread's trace (if any) into the ring with
+        [outcome], and return the error `data` payload carrying its id —
+        None when tracing is off, so `_encode_error` stays clean."""
+        ctx = tracectx.current()
+        if ctx is None:
+            return None
+        ctx.meta["outcome"] = outcome
+        if reason is not None:
+            ctx.meta["shed_reason"] = reason
+        if code is not None:
+            ctx.meta["error_code"] = code
+        tracectx.capture(ctx, outcome)
+        return {"traceId": ctx.trace_id}
 
     @staticmethod
     def _count_shed(method: str, reason: str, meta: Optional[dict]) -> None:
@@ -462,6 +527,7 @@ class RPCServer:
         dispatches up to [drain_timeout] (default: the rpc-drain-timeout
         knob), then report what was abandoned:
         {"drained": bool, "abandoned": n, "abandoned_methods": [...]}."""
+        self._draining = True
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

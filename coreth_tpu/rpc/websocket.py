@@ -41,6 +41,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..fault import failpoint, register
 from ..metrics import count_drop, default_registry
+from ..metrics import tracectx
 
 _GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 
@@ -264,15 +265,22 @@ class WSServer:
 
         def notify_writer() -> None:
             while True:
-                obj = notify_q.get()
-                if obj is None:
+                item = notify_q.get()
+                if item is None:
                     return
+                ctx, obj = item
                 try:
-                    failpoint("ws/before_notify")
-                    send_json(obj)
+                    # the producer's trace context (captured at enqueue)
+                    # rides across the writer-thread boundary, so a
+                    # notify failure attributes back to the block insert
+                    # or request that produced the event
+                    with tracectx.scope(ctx):
+                        failpoint("ws/before_notify")
+                        send_json(obj)
                 except Exception:
                     # a dead or erroring client ends *its* delivery only
                     count_drop("rpc/ws/notify_errors")
+                    tracectx.capture(ctx, "ws_notify_error")
                     drop_conn()
                     return
 
@@ -286,11 +294,13 @@ class WSServer:
             if closed.is_set():
                 default_registry.counter("rpc/ws/notify_drops").inc()
                 return
+            ctx = tracectx.current()
             try:
-                notify_q.put_nowait(obj)
+                notify_q.put_nowait((ctx, obj))
             except queue.Full:
                 default_registry.counter("rpc/ws/notify_drops").inc()
                 default_registry.counter("rpc/ws/slow_disconnects").inc()
+                tracectx.capture(ctx, "ws_notify_dropped")
                 drop_conn()
 
         if notify_q is not None:

@@ -192,7 +192,7 @@ class ResidentAccountMirror:
 
     # ---- device-failure takeover (VERDICT r4 #4) -------------------------
 
-    def _commit_root(self) -> bytes:
+    def _commit_root(self) -> bytes:  # guarded-by: _lock
         """Settle the trie's current state and return the 32-byte root —
         on the device while healthy, on the host after takeover. The
         device path runs under the watchdog; a wedge triggers the
@@ -205,6 +205,11 @@ class ResidentAccountMirror:
         with span("resident/commit", host_mode=self.host_mode):
             with phase_timer("resident/phase/commit"):
                 if self.host_mode:
+                    if self.ex is not None:
+                        # host commits move no bytes; a stale device-era
+                        # value here would be re-counted per commit by
+                        # anything summing ex.h2d_bytes across commits
+                        self.ex.h2d_bytes = 0
                     return self.trie.commit_cpu(threads=self._cpu_threads)
                 try:
                     if self.template:
@@ -235,6 +240,8 @@ class ResidentAccountMirror:
             why, self.trie.num_nodes)
         self.host_mode = True
         self.template = False  # host commits absorb by construction
+        if self.ex is not None:
+            self.ex.h2d_bytes = 0  # no further uploads after takeover
         self.trie.rehash_host(threads=self._cpu_threads)
         # the export delta marks predate the takeover; write a full
         # image at the next interval so disk supersedes any device-era
@@ -254,6 +261,14 @@ class ResidentAccountMirror:
     def _pipelining(self) -> bool:
         return (self.pipeline_depth > 0 and not self.host_mode
                 and not self.template and self.ex is not None)
+
+    def _pipeline_gauge(self) -> None:  # guarded-by: _lock
+        # current window occupancy, exported so an operator can tell a
+        # saturated pipeline (depth pinned at max) from an idle one
+        from ..metrics import default_registry
+
+        default_registry.gauge("resident/pipeline/depth").update(
+            len(self._inflight))
 
     def _commit_dispatch(self, key: bytes, expected: bytes,  # guarded-by: _lock
                          updates) -> bytes:
@@ -278,6 +293,7 @@ class ResidentAccountMirror:
         self._inflight.append({
             "key": key, "expected": expected, "resolve": resolve,
             "t_dispatch": time.monotonic()})
+        self._pipeline_gauge()
         return expected
 
     def _drain_pipeline(self, leave: int = 0,  # guarded-by: _lock
@@ -295,20 +311,23 @@ class ResidentAccountMirror:
         if upto is not None and not any(
                 e["key"] == upto for e in self._inflight):
             return
-        while len(self._inflight) > max(0, leave):
-            ent = self._inflight.pop(0)
-            t0 = time.monotonic()
-            try:
-                root = ent["resolve"]()
-            except DeviceWedgedError as e:
-                self._inflight.insert(0, ent)
-                self._drain_on_host(str(e))
-                return
-            self._note_overlap(ent, t0)
-            if root != ent["expected"]:
-                self._pipeline_diverged(ent, root)
-            if upto is not None and ent["key"] == upto:
-                return
+        try:
+            while len(self._inflight) > max(0, leave):
+                ent = self._inflight.pop(0)
+                t0 = time.monotonic()
+                try:
+                    root = ent["resolve"]()
+                except DeviceWedgedError as e:
+                    self._inflight.insert(0, ent)
+                    self._drain_on_host(str(e))
+                    return
+                self._note_overlap(ent, t0)
+                if root != ent["expected"]:
+                    self._pipeline_diverged(ent, root)
+                if upto is not None and ent["key"] == upto:
+                    return
+        finally:
+            self._pipeline_gauge()
 
     def _note_overlap(self, ent: dict, t0: float) -> None:  # guarded-by: _lock
         """Record how much of this commit's device time the pipeline hid
@@ -331,6 +350,7 @@ class ResidentAccountMirror:
         hasher is the oracle the device was checked against all along
         (the PR 6 soft landing, now window-deep)."""
         window, self._inflight = list(self._inflight), []
+        self._pipeline_gauge()
         self._take_over_host(why)
         for _ in window:
             self._applied.pop()
@@ -373,6 +393,7 @@ class ResidentAccountMirror:
         default_registry.counter(
             "state/resident/pipeline_divergences").inc(1)
         stale, self._inflight = list(self._inflight), []
+        self._pipeline_gauge()
         key = ent["key"]
         if key in self._applied:
             idx = self._applied.index(key)
@@ -950,6 +971,7 @@ class ResidentAccountMirror:
         if self._inflight:
             self._inflight = [e for e in self._inflight
                               if e["key"] != block_hash]
+            self._pipeline_gauge()
         root = self._roots.pop(block_hash, None)
         if root is not None:
             keys = self._by_root.get(root)

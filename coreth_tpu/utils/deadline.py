@@ -27,11 +27,18 @@ __all__ = ["Deadline", "DeadlineExceeded", "check", "current", "remaining",
 
 
 class DeadlineExceeded(Exception):
-    """A cooperative checkpoint found the request past its budget."""
+    """A cooperative checkpoint found the request past its budget.  When
+    the expiring thread carries a trace context the trace id is stamped
+    on (`trace_id`), so the dispatch layer can attribute the expiry
+    end-to-end without re-deriving ambient state."""
 
-    def __init__(self, budget: float):
-        super().__init__(f"request exceeded its {budget:g}s budget")
+    def __init__(self, budget: float, trace_id: Optional[str] = None):
+        msg = f"request exceeded its {budget:g}s budget"
+        if trace_id:
+            msg += f" [trace {trace_id}]"
+        super().__init__(msg)
         self.budget = budget
+        self.trace_id = trace_id
 
 
 class Deadline:
@@ -51,7 +58,11 @@ class Deadline:
 
     def check(self) -> None:
         if time.monotonic() >= self._expires:
-            raise DeadlineExceeded(self.budget)
+            # the import and ambient lookup only run on the expiry path,
+            # never on the no-op checkpoint fast path
+            from ..metrics import tracectx
+
+            raise DeadlineExceeded(self.budget, tracectx.current_id())
 
 
 _tls = threading.local()

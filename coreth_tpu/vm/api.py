@@ -489,13 +489,20 @@ class FiltersAPI:
 
 
 def health_check(vm) -> dict:
-    """health.go: the VM is healthy when the acceptor is alive."""
-    healthy = vm.blockchain.acceptor_error is None
-    return {
-        "healthy": healthy,
+    """health.go: the VM is healthy when the acceptor is alive AND the
+    RPC front door is not mid-drain — a draining node must drop out of
+    its load balancer (503) before the lanes start shedding, not
+    after."""
+    out = {
+        "healthy": vm.blockchain.acceptor_error is None,
         "lastAcceptedHeight": vm.blockchain.last_accepted.number,
         "error": vm.blockchain.acceptor_error,
     }
+    server = getattr(vm, "rpc_server", None)
+    if server is not None and getattr(server, "draining", False):
+        out["healthy"] = False
+        out["draining"] = True
+    return out
 
 
 class DebugMetricsAPI:
@@ -597,6 +604,50 @@ class DebugMetricsAPI:
         if server is None:
             return {"pooled": False}
         return server.serving_status()
+
+    def traceRequest(self, trace_id: Optional[str] = None,
+                     n: Optional[int] = None) -> object:
+        """debug_traceRequest: span tree + admission/deadline/lane
+        metadata for one captured trace id — or, with no id, the last N
+        captured traces (newest last). The capture ring holds only
+        interesting traces: sheds, deadline expiries, abandoned handlers,
+        failed inserts, and completions slower than the SLO budget."""
+        from ..metrics import tracectx
+
+        if trace_id is None:
+            return tracectx.ring.last(16 if n is None else int(n))
+        rec = tracectx.ring.get(str(trace_id))
+        if rec is None:
+            raise RPCError(
+                -32000,
+                f"trace {trace_id} not captured (completed under budget, "
+                "tracing disabled, or evicted from the ring)")
+        return rec
+
+    def sloStatus(self) -> dict:
+        """debug_sloStatus: per-method latency percentiles from the
+        slo/* histograms vs the configured budgets — the live view of
+        the exposition's SLO families."""
+        from ..metrics import default_registry
+
+        server = getattr(self.vm, "rpc_server", None)
+        policy = getattr(server, "policy", None)
+        chain = getattr(self.vm, "blockchain", None)
+        cache_cfg = getattr(chain, "cache_config", None)
+        series = {}
+        for name, m in default_registry.each():
+            if not name.startswith("slo/") or not hasattr(m, "percentile"):
+                continue
+            p50, p90, p99 = m.percentiles((0.50, 0.90, 0.99))
+            series[name] = {
+                "count": m.count(), "p50": p50, "p90": p90, "p99": p99,
+            }
+        return {
+            "rpcSloBudget": getattr(policy, "slo_budget", None),
+            "chainInsertSloBudget": getattr(
+                cache_cfg, "insert_slo_budget", None),
+            "series": series,
+        }
 
     def syncStatus(self) -> dict:
         """debug_syncStatus: bootstrap progress — peers by ladder state

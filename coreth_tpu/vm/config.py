@@ -62,6 +62,9 @@ class Config:
     # per-websocket-client bounded notification queue; overflow
     # disconnects the slow client. 0 = legacy unbuffered direct writes
     ws_notify_queue_size: int = 256
+    # successful requests slower than this (seconds) are auto-captured
+    # into the trace ring (debug_traceRequest); 0 disables auto-capture
+    rpc_slo_budget: float = 1.0
 
     # --- caches ----------------------------------------------------------
     trie_clean_cache: int = 512        # MB
@@ -191,6 +194,15 @@ class Config:
     metrics_http_enabled: bool = False
     metrics_http_host: str = "127.0.0.1"
     metrics_http_port: int = 0
+    # request/insert trace-id propagation (metrics/tracectx.py):
+    # process-global like spans-enabled, so it only applies when set
+    # explicitly; the CORETH_TPU_TRACING env var seeds the default (on)
+    tracing_enabled: bool = True
+    # captured-trace ring capacity (debug_traceRequest window)
+    trace_ring_size: int = 256
+    # block-insert SLO budget (seconds): inserts slower than this are
+    # auto-captured into the trace ring; 0 disables auto-capture
+    chain_insert_slo_budget: float = 0.0
 
     # --- keystore ---------------------------------------------------------
     keystore_directory: str = ""
@@ -313,6 +325,16 @@ class Config:
         if self.span_ring_size <= 0:
             raise ValueError(
                 f"span-ring-size must be > 0 (got {self.span_ring_size})")
+        if self.trace_ring_size <= 0:
+            raise ValueError(
+                f"trace-ring-size must be > 0 (got {self.trace_ring_size})")
+        if self.rpc_slo_budget < 0:
+            raise ValueError(
+                f"rpc-slo-budget must be >= 0 (got {self.rpc_slo_budget})")
+        if self.chain_insert_slo_budget < 0:
+            raise ValueError(
+                f"chain-insert-slo-budget must be >= 0 "
+                f"(got {self.chain_insert_slo_budget})")
         if self.flight_recorder_size <= 0:
             raise ValueError(
                 f"flight-recorder-size must be > 0 "

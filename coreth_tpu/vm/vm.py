@@ -161,6 +161,14 @@ class VM:
             from ..metrics import spans as _spans
 
             _spans.tracer.set_capacity(self.full_config.span_ring_size)
+        if "tracing_enabled" in explicit:
+            from ..metrics import tracectx as _tracectx
+
+            _tracectx.set_enabled(self.full_config.tracing_enabled)
+        if "trace_ring_size" in explicit:
+            from ..metrics import tracectx as _tracectx
+
+            _tracectx.ring.set_capacity(self.full_config.trace_ring_size)
 
         # node keystore (node/ keystore dir role; backs avax.importKey/
         # exportKey/import/export and the eth/personal signing RPC)
@@ -222,6 +230,7 @@ class VM:
                 state_backend=full.state_backend,
                 shadow_check_interval=full.shadow_check_interval,
                 evm_parallel_workers=full.evm_parallel_workers,
+                insert_slo_budget=full.chain_insert_slo_budget,
             ),
             self.chain_config,
             genesis,

@@ -512,3 +512,182 @@ class TestDebugRPC:
             assert _rpc(server, "debug_setExpensiveMetrics", False) is False
         finally:
             m.enabled_expensive = before
+
+    def test_debug_trace_request(self, debug_server):
+        from coreth_tpu.metrics import tracectx
+
+        _, server = debug_server
+        ctx = tracectx.begin("rpc")
+        assert ctx is not None
+        ctx.meta["method"] = "eth_obsTest"
+        tracectx.capture(ctx, "shed", note="unit")
+        rec = _rpc(server, "debug_traceRequest", ctx.trace_id)
+        assert rec["trace_id"] == ctx.trace_id
+        assert rec["outcome"] == "shed"
+        assert rec["meta"]["method"] == "eth_obsTest"
+        listing = _rpc(server, "debug_traceRequest", None, 4)
+        assert any(r["trace_id"] == ctx.trace_id for r in listing)
+        with pytest.raises(RuntimeError, match="not captured"):
+            _rpc(server, "debug_traceRequest", "rpc-dead-beef")
+
+    def test_debug_slo_status_tolerates_stub_vm(self, debug_server):
+        from coreth_tpu.metrics import observe_slo
+
+        _, server = debug_server
+        observe_slo("slo/rpc/eth_obsSlo", 0.003, "rpc-obs-000001")
+        status = _rpc(server, "debug_sloStatus")
+        assert status["rpcSloBudget"] is None  # stub vm: no rpc server
+        s = status["series"]["slo/rpc/eth_obsSlo"]
+        assert s["count"] >= 1 and s["p50"] >= 0.0
+
+
+# ------------------------------------------------------- SLO histograms
+
+class TestSLOHistograms:
+    def test_bucketed_histogram_exports_histogram_family(self):
+        from coreth_tpu.metrics import DEFAULT_SLO_BUCKETS
+
+        reg = Registry()
+        h = reg.histogram("slo/rpc/eth_call", buckets=DEFAULT_SLO_BUCKETS)
+        for i in range(40):
+            h.update(0.004 * (i % 10), exemplar="rpc-test-%06x" % i)
+        h.update(99.0, exemplar="rpc-test-top")  # above the top bucket
+        text = reg.export_prometheus()
+        assert validate_exposition(text) == []
+        fam = "slo_rpc_eth_call"
+        assert f"# TYPE {fam} histogram" in text
+        assert f'{fam}_bucket{{le="+Inf"}} 41' in text
+        assert f"{fam}_count 41" in text
+        # cumulative counts are monotone over sorted bounds
+        cums = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+                if line.startswith(f"{fam}_bucket")]
+        assert cums == sorted(cums)
+        # exemplar comment lines carry trace ids per bucket
+        assert "# EXEMPLAR " in text and "trace_id=rpc-test-" in text
+
+    def test_plain_histogram_stays_summary(self):
+        reg = Registry()
+        reg.histogram("plain/h").update(1.0)
+        text = reg.export_prometheus()
+        assert "# TYPE plain_h summary" in text
+        assert "plain_h_bucket" not in text
+
+    def test_exemplar_value_within_bucket_bound(self):
+        reg = Registry()
+        h = reg.histogram("slo/x", buckets=(0.1, 1.0))
+        h.update(0.05, exemplar="t-low")
+        h.update(0.5, exemplar="t-mid")
+        ex = h.exemplars()
+        assert ex["0.1"]["trace_id"] == "t-low"
+        assert ex["0.1"]["value"] <= 0.1
+        assert ex["1.0"]["trace_id"] == "t-mid"
+
+    def test_validator_rejects_non_monotone_buckets(self):
+        bad = ("# HELP h h\n# TYPE h histogram\n"
+               'h_bucket{le="0.1"} 5\nh_bucket{le="1.0"} 3\n'
+               'h_bucket{le="+Inf"} 3\nh_sum 1\nh_count 3\n')
+        assert validate_exposition(bad) != []
+
+    def test_validator_rejects_unknown_exemplar_bucket(self):
+        bad = ("# HELP h h\n# TYPE h histogram\n"
+               'h_bucket{le="0.1"} 1\nh_bucket{le="+Inf"} 1\n'
+               "h_sum 0.05\nh_count 1\n"
+               '# EXEMPLAR h_bucket{le="9.9"} trace_id=t value=0.05\n')
+        assert validate_exposition(bad) != []
+
+
+# ------------------------------------------------------- trace context
+
+class TestTraceContext:
+    def test_mint_is_unique_and_kind_prefixed(self):
+        from coreth_tpu.metrics import tracectx
+
+        a, b = tracectx.mint("rpc"), tracectx.mint("rpc")
+        assert a != b and a.startswith("rpc-") and b.startswith("rpc-")
+
+    def test_scope_installs_and_restores(self):
+        from coreth_tpu.metrics import tracectx
+
+        assert tracectx.current() is None
+        ctx = tracectx.begin("insert")
+        with tracectx.scope(ctx):
+            assert tracectx.current() is ctx
+            assert tracectx.current_id() == ctx.trace_id
+        assert tracectx.current() is None
+        with tracectx.scope(None):  # no-op scope needs no branching
+            assert tracectx.current() is None
+
+    def test_ring_is_bounded_and_keyed(self):
+        from coreth_tpu.metrics.tracectx import TraceRing
+
+        ring = TraceRing(capacity=3)
+        for i in range(5):
+            ring.put({"trace_id": f"t-{i}", "outcome": "shed"})
+        assert len(ring) == 3
+        assert ring.get("t-0") is None  # evicted
+        assert ring.get("t-4")["trace_id"] == "t-4"
+        assert [r["trace_id"] for r in ring.last(2)] == ["t-3", "t-4"]
+
+    def test_spans_bounded_per_trace(self):
+        from coreth_tpu.metrics import tracectx
+
+        ctx = tracectx.begin("rpc")
+        for i in range(tracectx.MAX_SPANS_PER_TRACE + 10):
+            ctx.add_span({"name": f"s{i}"})
+        assert len(ctx.spans) == tracectx.MAX_SPANS_PER_TRACE
+
+    def test_deadline_exceeded_carries_trace_id(self):
+        from coreth_tpu.metrics import tracectx
+        from coreth_tpu.utils import deadline as dl
+
+        ctx = tracectx.begin("rpc")
+        with tracectx.scope(ctx):
+            with dl.scope(dl.Deadline(0.0)):
+                with pytest.raises(dl.DeadlineExceeded) as e:
+                    dl.check()
+        assert e.value.trace_id == ctx.trace_id
+        assert ctx.trace_id in str(e.value)
+
+
+# ------------------------------------------------------- healthz draining
+
+class TestHealthzDraining:
+    def _vm(self, server):
+        import types as _types
+
+        chain = _types.SimpleNamespace(
+            acceptor_error=None,
+            last_accepted=_types.SimpleNamespace(number=7))
+        return _types.SimpleNamespace(blockchain=chain, rpc_server=server)
+
+    def test_health_check_reports_draining(self):
+        from coreth_tpu.rpc.server import RPCServer
+        from coreth_tpu.vm.api import health_check
+
+        srv = RPCServer()
+        vm = self._vm(srv)
+        assert health_check(vm)["healthy"] is True
+        srv.stop()
+        verdict = health_check(vm)
+        assert verdict["healthy"] is False
+        assert verdict["draining"] is True
+
+    def test_healthz_endpoint_returns_503_while_draining(self):
+        from coreth_tpu.rpc.server import RPCServer
+        from coreth_tpu.vm.api import health_check
+
+        srv = RPCServer()
+        vm = self._vm(srv)
+        msrv = MetricsHTTPServer(registry=Registry(),
+                                 health_fn=lambda: health_check(vm))
+        port = msrv.start(host="127.0.0.1", port=0)
+        try:
+            status, _, body = _get(port, "/healthz")
+            assert status == 200 and json.loads(body)["healthy"] is True
+            srv.stop()
+            status, _, body = _get(port, "/healthz")
+            payload = json.loads(body)
+            assert status == 503
+            assert payload["draining"] is True
+        finally:
+            msrv.stop()

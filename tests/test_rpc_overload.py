@@ -630,6 +630,125 @@ class TestKnobs:
         assert RPCServer().serving_status() == {"pooled": False}
 
 
+# --- trace propagation (ISSUE 12) ------------------------------------------
+
+
+class TestTracePropagation:
+    def test_span_parenting_survives_lane_handoff(self):
+        """The worker-side rpc/<method> span must parent under the
+        transport thread's open span even though it runs on a pool
+        worker: admission snapshots the span id into the trace ctx and
+        the worker-side root span inherits it."""
+        from coreth_tpu.metrics import spans as sp
+
+        srv = _server(max_workers=1, queue_size=4)
+        sp.set_enabled(True)
+        try:
+            sp.tracer.clear()
+            with sp.span("test/transport") as outer:
+                resp = _rpc(srv, "eth_ping")
+            assert resp["result"] == "pong"
+            handled = [s for s in sp.tracer.snapshot()
+                       if s.name == "rpc/eth_ping"]
+            assert handled, "worker-side span missing from the ring"
+            worker_span = handled[-1]
+            assert worker_span.tid != threading.get_ident(), \
+                "handler must have run on a lane worker"
+            assert worker_span.parent_id == outer.span_id
+            assert worker_span.attrs.get("trace_id", "").startswith("rpc-")
+        finally:
+            sp.set_enabled(False)
+            sp.tracer.clear()
+            srv.stop()
+
+    def test_shed_trace_resolvable_with_lane_metadata(self):
+        from coreth_tpu.vm.api import DebugMetricsAPI
+
+        srv = _server(max_workers=1, queue_size=1)
+        fault.set_failpoint("rpc/before_dispatch", "hang")
+        waiters = []
+        try:
+            # park the worker FIRST, then fill the queue slot — submitting
+            # both at once races the worker's dequeue: rid=2 can hit a
+            # still-occupied queue and get shed, leaving the queue empty
+            t1 = threading.Thread(
+                target=lambda: _rpc(srv, "eth_ping", rid=1), daemon=True)
+            t1.start()
+            waiters.append(t1)
+            _poll(lambda: _fired("rpc/before_dispatch") >= 1, "worker parked")
+            t2 = threading.Thread(
+                target=lambda: _rpc(srv, "eth_ping", rid=2), daemon=True)
+            t2.start()
+            waiters.append(t2)
+            _poll(lambda: srv.policy.cheap_pool._q.qsize() >= 1, "queue full")
+            resp = _rpc(srv, "eth_ping", rid=3)
+            assert resp["error"]["code"] == LIMIT_EXCEEDED
+            rec = DebugMetricsAPI(types.SimpleNamespace()).traceRequest(
+                resp["error"]["data"]["traceId"])
+            assert rec["outcome"] == "shed"
+            assert rec["meta"]["method"] == "eth_ping"
+            assert rec["meta"]["shed_reason"] == "queue_full"
+            assert rec["meta"]["error_code"] == LIMIT_EXCEEDED
+        finally:
+            fault.set_failpoint("rpc/before_dispatch", None)
+            for t in waiters:
+                t.join(5)
+            srv.stop()
+
+    def test_deadline_expiry_stamps_trace_id(self):
+        srv = _server(max_workers=1, queue_size=4, cheap_budget=0.02)
+        fault.set_failpoint("rpc/before_dispatch", "hang:80")
+        try:
+            resp = _rpc(srv, "eth_ping", rid=1)
+            assert resp["error"]["code"] == TIMEOUT_ERROR
+            assert "trace " in resp["error"]["message"]
+            tid = resp["error"]["data"]["traceId"]
+            assert tid in resp["error"]["message"]
+            from coreth_tpu.metrics import tracectx
+            rec = tracectx.ring.get(tid)
+            assert rec is not None
+            assert rec["outcome"] == "deadline_expired"
+            assert rec["meta"]["budget_s"] == 0.02
+            assert rec["meta"]["lane"] == "cheap"
+        finally:
+            fault.set_failpoint("rpc/before_dispatch", None)
+            srv.stop()
+
+    def test_slow_request_auto_captured_over_slo_budget(self):
+        from coreth_tpu.metrics import tracectx
+
+        srv = _server(max_workers=1, queue_size=4, slo_budget=0.01)
+        fault.set_failpoint("rpc/before_dispatch", "hang:40")
+        try:
+            resp = _rpc(srv, "eth_ping", rid=1)
+            assert resp["result"] == "pong"  # slow, but successful
+            slow = [r for r in tracectx.ring.last(8)
+                    if r["outcome"] == "slow"
+                    and r["meta"].get("method") == "eth_ping"]
+            assert slow, "over-budget completion must be auto-captured"
+            assert slow[-1]["meta"]["over_slo_budget_s"] == 0.01
+            assert slow[-1]["elapsed_s"] > 0.01
+        finally:
+            fault.set_failpoint("rpc/before_dispatch", None)
+            srv.stop()
+
+    def test_slo_status_reports_percentiles_vs_budget(self):
+        from coreth_tpu.vm.api import DebugMetricsAPI
+
+        srv = _server(max_workers=1, queue_size=4, slo_budget=0.25)
+        try:
+            for rid in range(4):
+                assert _rpc(srv, "eth_ping", rid=rid)["result"] == "pong"
+            vm = types.SimpleNamespace(rpc_server=srv)
+            status = DebugMetricsAPI(vm).sloStatus()
+            assert status["rpcSloBudget"] == 0.25
+            s = status["series"]["slo/rpc/eth_ping"]
+            assert s["count"] >= 4
+            assert 0.0 <= s["p50"] <= s["p99"]
+        finally:
+            srv.stop()
+
+
 # --- the acceptance drill --------------------------------------------------
 
 
@@ -673,6 +792,10 @@ class TestOverloadDrill:
             t.join(30)
             assert not t.is_alive(), "storm request wedged"
 
+        # every shed/expired answer must be attributable end-to-end: its
+        # error data carries a trace id resolvable via debug_traceRequest
+        from coreth_tpu.vm.api import DebugMetricsAPI
+        debug = DebugMetricsAPI(types.SimpleNamespace())
         for i, (method, _p) in enumerate(jobs):
             resp = results[i]
             if method == "eth_ping":
@@ -684,6 +807,12 @@ class TestOverloadDrill:
                                                      TIMEOUT_ERROR)
                     if resp["error"]["code"] == LIMIT_EXCEEDED:
                         assert lat[i] < 1.0, "sheds must answer fast"
+                    tid = resp["error"]["data"]["traceId"]
+                    rec = debug.traceRequest(tid)
+                    assert rec["trace_id"] == tid
+                    assert rec["meta"]["method"] == "eth_getLogs"
+                    assert rec["outcome"] in ("shed", "deadline_expired",
+                                              "stuck", "abandoned")
                 else:
                     assert resp["result"] == []
         assert _count("rpc/shed") > sheds_before, "storm must shed"
@@ -702,8 +831,10 @@ class TestOverloadDrill:
         # second storm, then drain mid-storm: stop() returns within its
         # bound and every outstanding request gets an answer
         fault.set_failpoint("rpc/before_dispatch_expensive", "hang")
+        storm2_resp = [None] * 3
         storm2 = [threading.Thread(
-            target=lambda i=i: _rpc(srv, "eth_getLogs", [{}], rid=200 + i),
+            target=lambda i=i: storm2_resp.__setitem__(
+                i, _rpc(srv, "eth_getLogs", [{}], rid=200 + i)),
             daemon=True) for i in range(3)]
         for t in storm2:
             t.start()
@@ -717,3 +848,9 @@ class TestOverloadDrill:
         for t in storm2:
             t.join(5)
             assert not t.is_alive(), "drain must answer every waiter"
+        # abandoned answers are attributable too
+        for resp in storm2_resp:
+            if resp is not None and "error" in resp:
+                rec = debug.traceRequest(resp["error"]["data"]["traceId"])
+                assert rec["outcome"] in ("abandoned", "shed", "stuck",
+                                          "deadline_expired")

@@ -1,0 +1,148 @@
+"""Bench-trajectory sentinel (ISSUE 12): artifact ingestion across the
+three artifact shapes, provenance tagging, noise-aware regression
+detection, and the CLI contract tools/lint.sh relies on (clean skip on an
+artifact-less checkout, nonzero exit on a regression)."""
+
+import json
+import os
+
+from coreth_tpu.bench.trajectory import (OUTPUT, build_trajectory,
+                                         load_artifacts, main)
+
+
+def _suite(tmp_path, rnd, value, platform="cpu-backend (tunnel wedged)",
+           config=3, metric="block_insert_1k_txs_per_sec", unit="txs/s",
+           extra=None):
+    results = [{"config": config, "metric": metric, "value": value,
+                "unit": unit, "vs_baseline": 1.0}]
+    if extra:
+        results += extra
+    (tmp_path / f"BENCH_SUITE_r{rnd:02d}.json").write_text(json.dumps(
+        {"round": rnd, "platform": platform, "results": results}))
+
+
+def _series(out):
+    return out["series"]
+
+
+class TestIngestion:
+    def test_three_artifact_shapes_normalize(self, tmp_path):
+        _suite(tmp_path, 1, 1000.0)
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+            "n": 1, "cmd": "python bench.py", "rc": 0, "tail": "",
+            "parsed": {"metric": "trie_commit_nodes_per_sec",
+                       "value": 32000.0, "unit": "nodes/s",
+                       "vs_baseline": 0.4}}))
+        (tmp_path / "BENCH_EARLY_r01.json").write_text(json.dumps({
+            "metric": "trie_commit_nodes_per_sec", "value": 59000.0,
+            "unit": "nodes/s", "platform": "TPU v5 lite (axon tunnel, live)",
+            "mode": "early"}))
+        points, skipped = load_artifacts(str(tmp_path))
+        assert len(points) == 3 and skipped == []
+        out = build_trajectory(points, skipped)
+        assert set(_series(out)) == {
+            "cfg=3|block_insert_1k_txs_per_sec|xla-cpu-standin",
+            "cfg=device-leg|trie_commit_nodes_per_sec|real-device",
+            "cfg=early|trie_commit_nodes_per_sec|real-device",
+        }
+
+    def test_provenance_tags(self, tmp_path):
+        # host_mode flag (even from a metric-less companion dict) beats
+        # the platform string; "live" platforms are real-device
+        _suite(tmp_path, 1, 200.0, platform="TPU v5 (live)", config=10,
+               metric="resident_block_insert_txs_per_sec",
+               extra=[{"config": 10, "host_mode": True,
+                       "cold_txs_per_sec": 190.0}])
+        points, _ = load_artifacts(str(tmp_path))
+        assert points[0]["provenance"] == "host_mode"
+
+    def test_unmeasured_device_leg_is_skipped_not_a_point(self, tmp_path):
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+            "n": 2, "cmd": "python bench.py", "rc": 0, "tail": "",
+            "parsed": {"metric": "trie_commit_nodes_per_sec", "value": 0.0,
+                       "unit": "nodes/s", "vs_baseline": 0.0,
+                       "error": "device wedged: tunnel hang"}}))
+        points, skipped = load_artifacts(str(tmp_path))
+        assert points == []
+        assert len(skipped) == 1
+        assert "wedged" in skipped[0]["reason"]
+
+    def test_multichip_and_own_output_out_of_scope(self, tmp_path):
+        (tmp_path / "MULTICHIP_r01.json").write_text("{}")
+        (tmp_path / OUTPUT).write_text('{"schema": "stale"}')
+        points, skipped = load_artifacts(str(tmp_path))
+        assert points == [] and skipped == []
+
+
+class TestRegressionGate:
+    def test_twenty_percent_regression_fails_check(self, tmp_path, capsys):
+        for rnd, v in ((1, 1000.0), (2, 1010.0), (3, 995.0), (4, 800.0)):
+            _suite(tmp_path, rnd, v)
+        rc = main(["--check", "--root", str(tmp_path)])
+        assert rc == 1
+        assert "REGRESSION" in capsys.readouterr().out
+        out = json.loads((tmp_path / OUTPUT).read_text())
+        assert len(out["regressions"]) == 1
+        key = out["regressions"][0]["series"]
+        assert out["series"][key]["status"] == "regression"
+
+    def test_stable_series_passes(self, tmp_path):
+        for rnd, v in ((1, 1000.0), (2, 1010.0), (3, 995.0), (4, 1005.0)):
+            _suite(tmp_path, rnd, v)
+        assert main(["--check", "--root", str(tmp_path)]) == 0
+
+    def test_in_band_dip_is_not_a_regression(self, tmp_path):
+        # 8% down is inside the 10% relative floor
+        for rnd, v in ((1, 1000.0), (2, 1010.0), (3, 995.0), (4, 920.0)):
+            _suite(tmp_path, rnd, v)
+        assert main(["--check", "--root", str(tmp_path)]) == 0
+
+    def test_noisy_series_never_gates(self, tmp_path):
+        # tunnel-era swings: relative MAD way past 0.5 -> reported, not gated
+        for rnd, v in ((1, 100.0), (2, 1700.0), (3, 300.0), (4, 40.0)):
+            _suite(tmp_path, rnd, v)
+        assert main(["--check", "--root", str(tmp_path)]) == 0
+        out = json.loads((tmp_path / OUTPUT).read_text())
+        assert list(out["series"].values())[0]["status"] == "noisy"
+
+    def test_lower_is_better_direction(self, tmp_path):
+        for rnd, v in ((1, 1.0), (2, 1.02), (3, 0.99), (4, 1.5)):
+            _suite(tmp_path, rnd, v, metric="chain_insert_latency_s",
+                   unit="s")
+        rc = main(["--check", "--root", str(tmp_path)])
+        assert rc == 1
+
+    def test_short_series_unchecked(self, tmp_path):
+        for rnd, v in ((1, 1000.0), (2, 500.0)):
+            _suite(tmp_path, rnd, v)
+        assert main(["--check", "--root", str(tmp_path)]) == 0
+        out = json.loads((tmp_path / OUTPUT).read_text())
+        assert list(out["series"].values())[0]["status"] == "short"
+
+
+class TestCLIContract:
+    def test_empty_checkout_skips_cleanly(self, tmp_path, capsys):
+        assert main(["--check", "--root", str(tmp_path)]) == 0
+        assert "nothing to check" in capsys.readouterr().out
+        assert not (tmp_path / OUTPUT).exists()
+
+    def test_output_is_deterministic(self, tmp_path):
+        for rnd, v in ((1, 1000.0), (2, 1010.0), (3, 995.0)):
+            _suite(tmp_path, rnd, v)
+        assert main(["--root", str(tmp_path)]) == 0
+        first = (tmp_path / OUTPUT).read_text()
+        assert main(["--root", str(tmp_path)]) == 0
+        assert (tmp_path / OUTPUT).read_text() == first
+
+    def test_real_repo_artifacts_pass(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if not any(f.startswith("BENCH_") and f != OUTPUT
+                   for f in os.listdir(repo)):
+            return  # artifact-less checkout: nothing to assert
+        points, _ = load_artifacts(repo)
+        out = build_trajectory(points, [])
+        assert out["regressions"] == []
+        # every device leg carries a provenance tag
+        assert all(s["provenance"] in
+                   ("real-device", "xla-cpu-standin", "host_mode")
+                   for s in out["series"].values())

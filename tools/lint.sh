@@ -15,6 +15,11 @@ echo "== coreth_tpu.metrics --check (Prometheus exposition self-test) =="
 python -m coreth_tpu.metrics --check || rc=1
 
 echo
+echo "== coreth_tpu.bench.trajectory --check (bench regression sentinel) =="
+# skips cleanly (exit 0) when the checkout carries no BENCH_* artifacts
+python -m coreth_tpu.bench.trajectory --check || rc=1
+
+echo
 if python -c "import mypy" >/dev/null 2>&1; then
     echo "== mypy (strict core subset, mypy.ini) =="
     python -m mypy --config-file mypy.ini || rc=1
