@@ -17,6 +17,9 @@ Configs (BASELINE.md "measurable baselines"):
   11-12 (dispatch-fusion A/B; interpreter dispatch micro-bench)
   13 chain-level insert with state-backend=bintrie-shadow — dual-root
      commitment overhead, per-backend chain/commit/{mpt,bintrie} timers
+  14 serial vs optimistic-parallel (Block-STM) execution worker sweep
+  15 staged insert-pipeline depth sweep {0,1,2,3} — recover/execute of
+     block k+1 overlapped with commit/write of block k, CPU legs first
 
 Each line: {"metric", "value", "unit", "vs_baseline", "config"} where
 vs_baseline compares the accelerated path against the host baseline of
@@ -85,7 +88,9 @@ def bench_2():
 
 def _block_insert_rate(resident: bool = False, state_backend: str = "mpt",
                        parallel_workers: int = 0, pipeline_depth: int = 0,
-                       template_residency: bool = False):
+                       template_residency: bool = False,
+                       insert_pipeline_depth: int = 0,
+                       per_block: int = 500):
     """1k-tx block processing: build the blocks, then time insert_block
     (ecrecover via the native batch + EVM + state commit). Returns
     (n_txs, txs_per_sec). resident=True routes the account trie through
@@ -95,7 +100,12 @@ def _block_insert_rate(resident: bool = False, state_backend: str = "mpt",
     runs the planned-semantics/resident-cost template mode;
     state_backend="bintrie-shadow" mounts the dual-root commitment
     shadow (config-13 measures its overhead); parallel_workers>0 runs
-    the optimistic Block-STM executor (config-14 A/Bs it vs serial)."""
+    the optimistic Block-STM executor (config-14 A/Bs it vs serial);
+    insert_pipeline_depth>0 mounts the staged insert pipeline (config-15
+    overlaps recover/execute of block k+1 with commit/write of block k —
+    the timed region includes the pipeline drain so queued speculation
+    can't flatter the rate). per_block sets txs per generated block
+    (smaller blocks -> more blocks -> more stage handoffs to overlap)."""
     from coreth_tpu import params
     from coreth_tpu.consensus.dummy import new_dummy_engine
     from coreth_tpu.core.blockchain import BlockChain, CacheConfig
@@ -124,7 +134,8 @@ def _block_insert_rate(resident: bool = False, state_backend: str = "mpt",
                     state_backend=state_backend,
                     evm_parallel_workers=parallel_workers,
                     resident_pipeline_depth=pipeline_depth,
-                    resident_template_residency=template_residency),
+                    resident_template_residency=template_residency,
+                    insert_pipeline_depth=insert_pipeline_depth),
         params.TEST_CHAIN_CONFIG,
         genesis, new_dummy_engine(),
         state_database=Database(TrieDatabase(diskdb)),
@@ -140,7 +151,6 @@ def _block_insert_rate(resident: bool = False, state_backend: str = "mpt",
     # gas limits cap a block well under 1k transfers; the workload
     # spans ceil(n/per_block) full blocks (core/bench_test.go ring1000
     # shape), timed over all inserts
-    per_block = 500
     n_blocks = (n_txs + per_block - 1) // per_block
 
     def gen(i, bg):
@@ -165,6 +175,8 @@ def _block_insert_rate(resident: bool = False, state_backend: str = "mpt",
     t0 = time.perf_counter()
     for b in blocks:
         chain.insert_block(b)
+    if chain.pipeline is not None:
+        chain.pipeline.drain()  # inserts are async under the pipeline
     dt = time.perf_counter() - t0
     chain.stop()  # drains the write tail, so "write" stamps are final
     _LAST_INSERT_INFO["flight"] = chain.flight_recorder.last()
@@ -768,6 +780,63 @@ def bench_14():
           best_rate / serial_rate)
 
 
+def bench_15():
+    """Staged insert-pipeline A/B (config-15, ROADMAP item 4a): the
+    config-3 insert workload at per_block=125 (more, smaller blocks —
+    more commit/speculate handoffs for the pipeline to overlap), swept
+    over insert-pipeline-depth {0,1,2,3}. All legs are CPU and land
+    first; a resident device leg at the best depth follows only when
+    the native planner is mounted. Per depth reports txs/s, the
+    spec/fallback block split from the flight records, and the mean
+    chain-level overlap fraction (speculation time of block k+1 inside
+    block k's commit interval). On this GIL-bound single-core host the
+    overlap is concurrency, not parallelism — expect fractions well
+    above 0 but a modest rate ratio, and report both honestly.
+    vs_baseline = best pipelined txs/s / depth-0 txs/s."""
+    per_block = 125
+    _, serial_rate = _block_insert_rate(per_block=per_block)
+    sweep = {}
+    best_rate = serial_rate
+    best_depth = 0
+    for depth in (1, 2, 3):
+        _, rate = _block_insert_rate(insert_pipeline_depth=depth,
+                                     per_block=per_block)
+        pipes = [r.get("pipeline", {})
+                 for r in _LAST_INSERT_INFO.get("flight", [])]
+        modes = [p.get("mode") for p in pipes]
+        overlaps = [p.get("overlap_fraction", 0.0) or 0.0 for p in pipes]
+        sweep[depth] = {
+            "txs_per_sec": round(rate, 1),
+            "ratio_vs_serial": round(rate / serial_rate, 3),
+            "spec_blocks": modes.count("spec"),
+            "fallback_blocks": modes.count("serial-fallback"),
+            "mean_overlap_fraction": round(
+                sum(overlaps) / len(overlaps), 4) if overlaps else 0.0,
+        }
+        if rate > best_rate:
+            best_rate, best_depth = rate, depth
+    report = {
+        "config": 15,
+        "serial_txs_per_sec": round(serial_rate, 1),
+        "depths": sweep,
+        "best_depth": best_depth,
+    }
+    # optional device leg, strictly after every CPU leg is recorded:
+    # pipelined insert + resident mirror exercises the chain-level
+    # overlap the mirror window was built for
+    try:
+        _, res_rate = _block_insert_rate(
+            resident=True, insert_pipeline_depth=max(best_depth, 1),
+            per_block=per_block)
+        report["resident_txs_per_sec"] = round(res_rate, 1)
+        report["resident_host_mode"] = _LAST_INSERT_INFO.get("host_mode")
+    except RuntimeError as e:
+        report["resident_skipped"] = str(e)
+    print(json.dumps(report), flush=True)
+    _emit(15, "pipelined_block_insert_txs_per_sec", best_rate, "txs/s",
+          best_rate / serial_rate)
+
+
 def main():
     from coreth_tpu.utils import enable_compilation_cache
 
@@ -785,7 +854,7 @@ def main():
     watchdog = PhaseWatchdog(
         time.monotonic() + float(os.environ.get("CORETH_TPU_BENCH_WATCHDOG",
                                                 "1800")))
-    picks = [int(a) for a in sys.argv[1:]] or list(range(1, 15))
+    picks = [int(a) for a in sys.argv[1:]] or list(range(1, 16))
     for i in picks:
         # configs 7/9 run bench.py legs under their own phase watchdogs
         # with larger budgets (900s cold warmup); the outer arm must not

@@ -744,6 +744,9 @@ class FailpointHygieneRule(Rule):
 # fan out remote work are listed: peer/ (gossip + request fan-out pools)
 # and sync/ (segment + hedge pools driven by remote responses) joined in
 # PR 9 — a Byzantine peer set must not be able to balloon either.
+# core/insert_pipeline.py joins in PR 13: its stage queue IS the
+# pipeline depth bound — an unbounded queue there would let speculation
+# run arbitrarily far ahead of commit.
 SERVING_PATHS = (
     "coreth_tpu/rpc/",
     "coreth_tpu/vm/api.py",
@@ -751,6 +754,7 @@ SERVING_PATHS = (
     "coreth_tpu/metrics/http.py",
     "coreth_tpu/peer/",
     "coreth_tpu/sync/",
+    "coreth_tpu/core/insert_pipeline.py",
 )
 _QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue"}
 

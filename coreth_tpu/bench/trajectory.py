@@ -2,7 +2,7 @@
 
 Ingests every per-round bench artifact in the repo root — `BENCH_rNN.json`
 (the config-1 device leg run through the axon tunnel), `BENCH_EARLY_rNN.json`
-(the pre-suite early capture), `BENCH_SUITE_rNN.json` (the 11-config suite)
+(the pre-suite early capture), `BENCH_SUITE_rNN.json` (the 15-config suite)
 — normalizes each measured leg into a (config, metric, provenance) series
 across rounds, and writes `BENCH_TRAJECTORY.json` with median + MAD noise
 bands per series.

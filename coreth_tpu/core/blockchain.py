@@ -74,6 +74,22 @@ FP_TAIL_BEFORE_HEAD = _register_failpoint(
     "after a block's body is durable, before the canonical-hash/"
     "head-pointer writes")
 
+# insert-stage failpoint sites: one per stage of the (optionally
+# pipelined) insert path. The serial path and the pipeline fire the same
+# names, so a drill armed at depth 0 and depth N tears the same stage —
+# that symmetry is what the bit-exactness sweeps lean on.
+FP_INSERT_BEFORE_RECOVER = _register_failpoint(
+    "insert/before_recover", "before sender-recovery dispatch")
+FP_INSERT_BEFORE_EXECUTE = _register_failpoint(
+    "insert/before_execute",
+    "after verify/recovery, before (speculative) execution")
+FP_INSERT_BEFORE_COMMIT = _register_failpoint(
+    "insert/before_commit",
+    "under chainmu, before the state commit of a validated block")
+FP_INSERT_BEFORE_WRITE = _register_failpoint(
+    "insert/before_write",
+    "after the state commit, before the block enters the insert tail")
+
 
 @dataclass
 class CacheConfig:
@@ -166,6 +182,13 @@ class CacheConfig:
     # block-insert SLO budget (seconds): inserts slower than this are
     # auto-captured into the trace ring (debug_traceRequest); 0 disables
     insert_slo_budget: float = 0.0
+    # staged insert pipeline depth (core/insert_pipeline.py): up to this
+    # many blocks stay in flight — block k+1's sender recovery and
+    # speculative execution overlap block k's commit/device-hash/tail
+    # write, with only the commit/write/canonical stage under chainmu.
+    # 0 = the serial insert path (every stage under chainmu, the seed
+    # behavior); validated range 0-3
+    insert_pipeline_depth: int = 0
 
 
 # counter/timer families snapshotted around each insert so the flight
@@ -186,20 +209,25 @@ _FLIGHT_TIMERS = (
 
 class _PhaseClock:
     """Times one insert phase into three sinks at once: the cumulative
-    `chain/phase/<name>` registry timer (bench attribution), the
-    in-flight block's flight record, and — when tracing is on — a
-    `chain/<name>` span. One extra dict store and two monotonic reads
-    per phase over the old bare registry timer."""
+    `<prefix><name>` registry timer (bench attribution; default
+    `chain/phase/`), the in-flight block's flight record, and — when
+    tracing is on — a `<span_prefix><name>` span. One extra dict store
+    and two monotonic reads per phase over the old bare registry timer.
+    The insert pipeline reuses it with a `chain/pipeline/` prefix so its
+    stage timers are a parallel family, not an overwrite of the serial
+    attribution."""
 
-    __slots__ = ("_timer", "_phases", "_name", "_span", "_t0")
+    __slots__ = ("_timer", "_phases", "_name", "_span_name", "_span", "_t0")
 
-    def __init__(self, name: str, phases: Dict[str, float], registry):
-        self._timer = registry.timer("chain/phase/" + name)
+    def __init__(self, name: str, phases: Dict[str, float], registry,
+                 prefix: str = "chain/phase/", span_prefix: str = "chain/"):
+        self._timer = registry.timer(prefix + name)
         self._phases = phases
         self._name = name
+        self._span_name = span_prefix + name
 
     def __enter__(self):
-        self._span = _span("chain/" + self._name)
+        self._span = _span(self._span_name)
         self._span.__enter__()
         self._t0 = time.monotonic()
         return self
@@ -355,9 +383,13 @@ class BlockChain:
         # per-chain flight recorder (metrics/flight.py): last-N per-block
         # phase/counter records, served by debug_blockFlightRecord
         self.flight_recorder = FlightRecorder(cache_config.flight_recorder_size)
-        # record of the insert currently running under chainmu; read by
-        # _insert_checked to attach phase context to bad-block entries
-        self._insert_rec: Optional[dict] = None
+        # records of inserts currently in flight, KEYED BY BLOCK HASH:
+        # with the pipeline on, block k+1's prepare stages overlap block
+        # k's commit, so a single slot would let one insert clobber the
+        # other's attribution. Read by _note_bad_block to attach phase
+        # context to bad-block entries.
+        self._insert_recs: Dict[bytes, dict] = {}  # guarded-by: _insert_recs_mu
+        self._insert_recs_mu = threading.Lock()
 
         # device degradation ladder (ops/device.py): configure the
         # process-wide ladder from this chain's knobs and pipe its
@@ -491,6 +523,17 @@ class BlockChain:
             target=self._start_acceptor, name="acceptor", daemon=True
         )
         self._acceptor_thread.start()
+
+        # staged insert pipeline (core/insert_pipeline.py, ROADMAP 4a):
+        # recover/verify/speculate on the caller thread, commit under
+        # chainmu on a single worker. Built last — it captures a fully
+        # constructed chain.
+        self.pipeline = None
+        if cache_config.insert_pipeline_depth > 0:
+            from .insert_pipeline import InsertPipeline
+
+            self.pipeline = InsertPipeline(
+                self, depth=cache_config.insert_pipeline_depth)
 
     # ------------------------------------------------------------- genesis
 
@@ -810,18 +853,35 @@ class BlockChain:
     # -------------------------------------------------------------- insert
 
     def insert_block(self, block: Block) -> None:
-        """InsertBlockManual(writes=True) (blockchain.go:1234-1389)."""
+        """InsertBlockManual(writes=True) (blockchain.go:1234-1389).
+
+        With insert-pipeline-depth > 0 the block is handed to the staged
+        pipeline instead: this call runs recovery/verification/
+        speculative execution (no chainmu) and returns once the block is
+        queued for its commit stage. A commit failure surfaces at the
+        next submit or drain point (accept/reject/set_preference/
+        insert_block_manual/stop) — same deferred-error contract as the
+        async insert tail."""
+        if self.pipeline is not None:
+            self.pipeline.submit(block)
+            return
         with self.chainmu:
             self._insert_checked(block, writes=True)
 
     def insert_block_manual(self, block: Block, writes: bool) -> None:
+        # a writes=False semantic check runs against the latest committed
+        # state; in-flight pipelined successors would race it — land them
+        # (and surface any deferred commit error) first
+        if self.pipeline is not None:
+            self.pipeline.drain()
         with self.chainmu:
             self._insert_checked(block, writes)
 
     def _insert_checked(self, block: Block, writes: bool) -> None:
-        """Record blocks that FAIL insertion in the bad-block ring
-        (eth/api.go GetBadBlocks / core reportBlock): operators debug
-        bad-root/gas-mismatch blocks from debug_getBadBlocks."""
+        """Serial insert with bad-block bookkeeping: failures land in the
+        bad-block ring (eth/api.go GetBadBlocks / core reportBlock) so
+        operators can debug bad-root/gas-mismatch blocks from
+        debug_getBadBlocks."""
         if self.get_header(block.header.parent_hash) is None:
             # unknown ancestor is an ORDERING condition, not a bad block
             # (geth's reportBlock is only reached by validation errors;
@@ -830,26 +890,30 @@ class BlockChain:
         try:
             self._insert_block(block, writes)
         except Exception as e:
-            # dedup by hash: consensus retries re-submit the same bad
-            # block, and each retry would otherwise evict a DISTINCT
-            # earlier failure from the bounded ring (the newest reason
-            # wins — it reflects the current chain state)
-            h = block.hash()
-            for i, (b, _, _) in enumerate(self.bad_blocks):
-                if b.hash() == h:
-                    del self.bad_blocks[i]
-                    break
-            # attach the in-flight record: phase timings up to the point
-            # of failure are exactly what an operator debugging a
-            # bad-root/gas-mismatch block needs
-            rec = self._insert_rec
-            if rec is not None and rec.get("hash") != h:
-                rec = None
-            self.bad_blocks.append(
-                (block, f"{type(e).__name__}: {e}", rec))
+            self._note_bad_block(block, e)
             raise
         finally:
-            self._insert_rec = None
+            with self._insert_recs_mu:
+                self._insert_recs.pop(block.hash(), None)
+
+    def _note_bad_block(self, block: Block, e: BaseException) -> None:
+        """Append a failed insert to the bounded bad-block ring with its
+        in-flight flight record attached — phase timings up to the point
+        of failure are exactly what an operator debugging a bad-root/
+        gas-mismatch block needs. Shared by the serial path and the
+        pipeline's commit worker."""
+        # dedup by hash: consensus retries re-submit the same bad
+        # block, and each retry would otherwise evict a DISTINCT
+        # earlier failure from the bounded ring (the newest reason
+        # wins — it reflects the current chain state)
+        h = block.hash()
+        for i, (b, _, _) in enumerate(self.bad_blocks):
+            if b.hash() == h:
+                del self.bad_blocks[i]
+                break
+        with self._insert_recs_mu:
+            rec = self._insert_recs.get(h)
+        self.bad_blocks.append((block, f"{type(e).__name__}: {e}", rec))
 
     def _insert_block(self, block: Block, writes: bool) -> None:
         from ..metrics import default_registry as _metrics
@@ -881,7 +945,8 @@ class BlockChain:
             "writes": writes,
             "trace_id": ctx.trace_id if ctx is not None else None,
         }
-        self._insert_rec = rec  # single writer: inserts hold chainmu
+        with self._insert_recs_mu:
+            self._insert_recs[block.hash()] = rec
         counters0 = {n: _metrics.counter(n).count() for n in _FLIGHT_COUNTERS}
         timers0 = {n: _metrics.timer(n).total() for n in _FLIGHT_TIMERS}
         phases = rec["phases"]
@@ -942,25 +1007,48 @@ class BlockChain:
                        writes: bool, rec: dict, phases: Dict[str, float],
                        insert_timer, _metrics) -> None:
         """Phase body of _insert_block (split so the flight-record
-        bookkeeping wraps it exactly once)."""
+        bookkeeping wraps it exactly once). This is the SERIAL path:
+        every stage runs here, under chainmu. The pipeline runs the
+        recover/verify/execute half on the submitting thread and shares
+        only _commit_validated — the one stage that needs the lock."""
         # overlap sender ecrecover with verification (blockchain.go:1247)
         from .sender_cacher import sender_cacher
         from .types import Signer
 
+        failpoint("insert/before_recover")
         with _PhaseClock("recover", phases, _metrics):
-            sender_cacher.recover(
+            token = sender_cacher.recover(
                 Signer(self.config.chain_id), block.transactions)
 
         with _PhaseClock("verify", phases, _metrics):
             self.engine.verify_header(self.config, header, parent)
             self.validator.validate_body(block)
 
-        # join the recovery batch before execution: losing the race means
-        # re-deriving senders one-by-one mid-execute, which duplicates the
-        # whole batch's work on small machines
+        # join THIS block's recovery batch before execution: losing the
+        # race means re-deriving senders one-by-one mid-execute, which
+        # duplicates the whole batch's work on small machines
         with _PhaseClock("recover", phases, _metrics):
-            sender_cacher.wait()
+            sender_cacher.wait(token)
 
+        failpoint("insert/before_execute")
+        statedb, receipts, logs, used_gas = self._execute_and_validate(
+            block, header, parent, rec, phases, _metrics, insert_timer)
+
+        if not writes:
+            return
+        self._commit_validated(block, statedb, receipts, logs, used_gas,
+                               rec, phases, _metrics)
+
+    def _execute_and_validate(self, block: Block, header: Header,
+                              parent: Header, rec: dict,
+                              phases: Dict[str, float], _metrics,
+                              insert_timer):
+        """Open the parent state, execute the block, and validate the
+        post-state against the header. No chain mutation — safe to run
+        outside chainmu as long as the parent's state stays reachable
+        (the serial path holds chainmu anyway; the pipeline's commit
+        worker calls this as the serial fallback, ordered after the
+        parent's commit)."""
         statedb = self.state_at(parent.root)
         if getattr(statedb.trie, "resident", False):
             # hand the header root to the mirror: with pipelining on,
@@ -985,8 +1073,19 @@ class BlockChain:
             statedb.stop_prefetcher()
 
         rec["gas_used"] = used_gas
-        if not writes:
-            return
+        return statedb, receipts, logs, used_gas
+
+    def _commit_validated(self, block: Block, statedb: StateDB,
+                          receipts: List[Receipt], logs: list,
+                          used_gas: int, rec: dict,
+                          phases: Dict[str, float],
+                          _metrics) -> None:  # guarded-by: chainmu
+        """Commit/device-hash/write/canonical stage for a block whose
+        post-state already validated. With pipelining on this is the
+        ONLY insert stage that holds chainmu — everything above it runs
+        on the submitting thread."""
+        header = block.header
+        failpoint("insert/before_commit")
 
         # count only committed inserts: locally built blocks run a
         # writes=False pre-verification first and must not double-count
@@ -1022,6 +1121,7 @@ class BlockChain:
 
         # committed inserts enter the ring; the async tail stamps `write`
         self.flight_recorder.record(rec)
+        failpoint("insert/before_write")
         self._write_block(block, receipts, statedb._deferred_snap_update,
                           rec=rec)
 
@@ -1282,6 +1382,13 @@ class BlockChain:
     def accept(self, block: Block) -> None:
         """Accept (blockchain.go:1034-1065): reorg to the accepted block if
         it is not canonical, then enqueue async post-processing."""
+        # land in-flight pipelined inserts BEFORE taking chainmu (the
+        # commit worker needs the lock to make progress — draining under
+        # it would deadlock). An accept of an in-flight block thereby
+        # waits for its commit; a deferred commit failure surfaces here,
+        # and the pipeline has already rewound the speculated successors.
+        if self.pipeline is not None:
+            self.pipeline.drain()
         with self.chainmu:
             canonical = self.get_canonical_hash(block.number)
             if canonical != block.hash():
@@ -1296,6 +1403,11 @@ class BlockChain:
 
     def reject(self, block: Block) -> None:
         """Reject (blockchain.go:1067-1094): drop refs for the losing block."""
+        # same ordering as accept: drain the pipeline outside chainmu so
+        # a reject of (or racing) an in-flight block sees it committed —
+        # or its speculation rewound — before refs are dropped
+        if self.pipeline is not None:
+            self.pipeline.drain()
         with self.chainmu:
             # the losing block's tail may still be queued; land it before
             # dropping the in-memory refs so disk state stays coherent
@@ -1362,6 +1474,11 @@ class BlockChain:
 
     def set_preference(self, block: Block) -> None:
         """SetPreference (blockchain.go:973-1012)."""
+        # a preference switch can reorg: rewind in-flight speculation
+        # first (outside chainmu — see accept) so the reorg never races
+        # a pipelined commit that extends the losing fork
+        if self.pipeline is not None:
+            self.pipeline.drain()
         with self.chainmu:
             self._set_preference_locked(block)
 
@@ -1424,6 +1541,10 @@ class BlockChain:
     # ------------------------------------------------------------ lifecycle
 
     def stop(self) -> None:
+        # retire the insert pipeline first: its commit worker feeds the
+        # acceptor/tail queues being drained below
+        if self.pipeline is not None:
+            self.pipeline.stop()
         self.drain_acceptor_queue()
         self._acceptor_queue.put(None)
         self._acceptor_thread.join(timeout=5)

@@ -1062,21 +1062,23 @@ def _replay_gas_pool(env: _ExecEnv, gas_limit: int) -> bool:
     return True
 
 
-def _fold_results(env: _ExecEnv, statedb, block):
+def fold_results(txs, results, coinbase: bytes, statedb, block):
     """Apply per-tx write-sets to the StateDB and build receipts in
-    tx-index order (the deterministic-commit half of Block-STM)."""
+    tx-index order (the deterministic-commit half of Block-STM). Shared
+    by execute_block and the insert pipeline's speculative commit —
+    [results] is a dense list of completed _TxResult, one per tx."""
     header = block.header
     block_hash = block.hash()
     used = 0
     receipts: List[Receipt] = []
     all_logs: List = []
-    for i in range(len(env.txs)):  # ascending tx index — consensus order
-        tx = env.txs[i]
-        r = env.results[i]
+    for i in range(len(txs)):  # ascending tx index — consensus order
+        tx = txs[i]
+        r = results[i]
         ws = r.ws
         tx_hash = tx.hash()
         statedb.fold_tx_writes(tx_hash, i, ws.accounts, ws.storage, ws.logs,
-                               ws.preimages, env.coinbase, ws.fee)
+                               ws.preimages, coinbase, ws.fee)
         used += r.result.used_gas
         receipt = Receipt(
             type=tx.type,
@@ -1181,7 +1183,8 @@ def execute_block(chain_config, block, parent, statedb, block_ctx,
         return None, stats
 
     t3 = time.monotonic()
-    receipts, all_logs, used = _fold_results(env, statedb, block)
+    receipts, all_logs, used = fold_results(
+        env.txs, env.results, env.coinbase, statedb, block)
     _t_fold.update(time.monotonic() - t3)
     stats["mode"] = "parallel"
     stats["fallback"] = False

@@ -7,14 +7,20 @@ the whole tx slice and dispatches to a pluggable batch recoverer — the
 C++ keccak path covers the hashing; the secp256k1 scalar work stays on
 CPU (BASELINE.json config #3 keeps verification host-side). A thread pool
 overlaps recovery with block execution.
+
+recover() tags each dispatch with a batch token so wait(token) joins one
+block's futures only: with the insert pipeline keeping two blocks in
+flight, a global wait would serialize block k+1's recovery behind block
+k's — exactly the stall the pipeline exists to remove.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from ..metrics import default_registry as _metrics
 from ..metrics.spans import span
@@ -35,23 +41,29 @@ class TxSenderCacher:
         self._pool = ThreadPoolExecutor(max_workers=self.threads)
         self._batch_recover = batch_recover
         self._lock = threading.Lock()
-        self._futures: list = []
+        # batch token -> outstanding futures for that recover() call
+        self._batches: Dict[int, list] = {}  # guarded-by: _lock
+        self._tokens = itertools.count(1)
 
-    def recover(self, signer: Signer, txs: List[Transaction]) -> None:
+    def recover(self, signer: Signer, txs: List[Transaction]) -> Optional[int]:
         """Kick off sender recovery for txs; results land in each tx's
-        _sender cache so later Sender() calls are free."""
+        _sender cache so later Sender() calls are free. Returns a batch
+        token for wait(token) (None when there was nothing to do)."""
         if not txs:
-            return
-        # prune finished futures so the fire-and-forget path stays bounded
+            return None
+        # prune finished batches so the fire-and-forget path stays bounded
         with self._lock:
-            self._futures = [f for f in self._futures if not f.done()]
+            for tok in [t for t, fs in self._batches.items()
+                        if all(f.done() for f in fs)]:
+                del self._batches[tok]
+            token = next(self._tokens)
         if self._batch_recover is not None:
             fut = self._pool.submit(self._batch_recover, signer, txs)
-            # under _lock: a concurrent wait() swaps the list out, and an
-            # unlocked append can land on the orphaned list and be lost
+            # under _lock: a concurrent wait() pops the batch, and an
+            # unlocked store can land after the pop and be lost
             with self._lock:
-                self._futures.append(fut)
-            return
+                self._batches[token] = [fut]
+            return token
 
         def work_batch(chunk, shard=0, of=1, native_threads=0):
             t0 = time.perf_counter()
@@ -93,14 +105,23 @@ class TxSenderCacher:
             futs = [self._pool.submit(work_batch, txs[i::n], i, n)
                     for i in range(n)]
         with self._lock:
-            self._futures.extend(futs)
+            self._batches[token] = futs
+        return token
 
-    def recover_from_block(self, signer: Signer, block) -> None:
-        self.recover(signer, block.transactions)
+    def recover_from_block(self, signer: Signer, block) -> Optional[int]:
+        return self.recover(signer, block.transactions)
 
-    def wait(self) -> None:
+    def wait(self, token: Optional[int] = None) -> None:
+        """Join one recover() batch (by token), or every outstanding
+        batch when token is None. A token that already completed (or was
+        pruned, or is None from an empty recover) is a no-op — senders
+        for those txs are cached either way."""
         with self._lock:
-            futures, self._futures = self._futures, []
+            if token is None:
+                futures = [f for fs in self._batches.values() for f in fs]
+                self._batches.clear()
+            else:
+                futures = self._batches.pop(token, [])
         for f in futures:
             f.result()
 

@@ -123,6 +123,11 @@ class Config:
     # host planning of block k+1 overlaps device execution of block k.
     # 0 = every commit synchronizes before verify returns
     resident_pipeline_depth: int = 0
+    # staged insert pipeline depth (0-3): up to this many successor
+    # blocks run sender recovery + speculative execution (against the
+    # predecessor's speculated post-state) while the predecessor holds
+    # chainmu for commit/device-hash/write. 0 = serial insert loop
+    insert_pipeline_depth: int = 0
     # template residency: per-commit device->host digest absorb keeps
     # the host cache warm (root/export always valid, instant takeover)
     # while the device keeps row arenas + digest store resident, so
@@ -286,6 +291,10 @@ class Config:
             raise ValueError(
                 f"resident-pipeline-depth must be in [0, 4] "
                 f"(got {self.resident_pipeline_depth})")
+        if not (0 <= self.insert_pipeline_depth <= 3):
+            raise ValueError(
+                f"insert-pipeline-depth must be in [0, 3] "
+                f"(got {self.insert_pipeline_depth})")
         if self.resident_template_residency not in (True, False):
             raise ValueError(
                 f"resident-template-residency must be a boolean "

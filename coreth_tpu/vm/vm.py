@@ -224,6 +224,7 @@ class VM:
                 resident_spot_check_interval=(
                     full.resident_spot_check_interval),
                 resident_pipeline_depth=full.resident_pipeline_depth,
+                insert_pipeline_depth=full.insert_pipeline_depth,
                 resident_template_residency=(
                     full.resident_template_residency),
                 tail_join_timeout=full.tail_join_timeout,

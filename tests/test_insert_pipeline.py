@@ -1,0 +1,524 @@
+"""Staged insert pipeline (ISSUE 13, ROADMAP item 4a): depth {0,1,2,3}
+determinism sweep over a conflict-shaped corpus, seeded fuzz parity,
+per-stage failpoint drills, keyed in-flight insert records, per-batch
+sender-cacher waits, accept/reject of in-flight blocks, and real-SIGKILL
+drills proving the PR 6 torn-tail repair holds when the tail FIFO
+carries two blocks' writes."""
+
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from coreth_tpu import fault, params
+from coreth_tpu.consensus.dummy import new_dummy_engine
+from coreth_tpu.core import rawdb
+from coreth_tpu.core.blockchain import BlockChain, CacheConfig
+from coreth_tpu.core.chain_makers import generate_chain
+from coreth_tpu.core.genesis import Genesis, GenesisAccount
+from coreth_tpu.core.sender_cacher import TxSenderCacher
+from coreth_tpu.core.types import Signer, Transaction
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.ethdb import MemoryDB
+from coreth_tpu.fault import FailpointError
+from coreth_tpu.metrics import default_registry
+from coreth_tpu.state.database import Database
+from coreth_tpu.trie.triedb import TrieDatabase
+
+# four funded senders whose nonce chains and balance transfers span
+# blocks — block k+1's txs read state block k wrote, which is exactly
+# what the pipeline's speculative overlay must get right
+KEYS = [bytes([0x11 * (i + 1)]) * 32 for i in range(4)]
+ADDRS = [priv_to_address(k) for k in KEYS]
+DEST = b"\xbb" * 20
+FUND = 10**22
+SIGNER = Signer(43112)
+
+
+def tx(key, nonce, to=DEST, value=1000):
+    t = Transaction(type=2, chain_id=43112, nonce=nonce, max_fee=10**12,
+                    max_priority_fee=10**9, gas=21000, to=to, value=value)
+    return SIGNER.sign(t, key)
+
+
+def fresh(depth=0, diskdb=None, **cache_kwargs):
+    diskdb = diskdb if diskdb is not None else MemoryDB()
+    genesis = Genesis(
+        config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+        alloc={a: GenesisAccount(balance=FUND) for a in ADDRS},
+    )
+    chain = BlockChain(
+        diskdb,
+        CacheConfig(commit_interval=4096, insert_pipeline_depth=depth,
+                    **cache_kwargs),
+        params.TEST_CHAIN_CONFIG, genesis, new_dummy_engine(),
+        state_database=Database(TrieDatabase(diskdb)),
+    )
+    return chain, diskdb, genesis
+
+
+def conflict_corpus(n_blocks, seed=None):
+    """Cross-block conflict shape: every sender's nonce chain spans all
+    blocks, and recipients repeat (other senders + DEST), so balances
+    read in block k+1 depend on writes from block k. seed adds fuzz on
+    top (random per-block tx counts, senders, recipients, values)."""
+    scratch, _, _ = fresh(depth=0)
+    nonces = {i: 0 for i in range(len(KEYS))}
+    rng = random.Random(seed) if seed is not None else None
+
+    def gen(i, bg):
+        if rng is None:
+            for s in range(len(KEYS)):
+                to = ADDRS[(s + i + 1) % len(ADDRS)]
+                bg.add_tx(tx(KEYS[s], nonces[s], to=to, value=1000 + i))
+                nonces[s] += 1
+        else:
+            for _ in range(rng.randrange(1, 7)):
+                s = rng.randrange(len(KEYS))
+                to = rng.choice(ADDRS + [DEST, b"\xcc" * 20])
+                bg.add_tx(tx(KEYS[s], nonces[s], to=to,
+                             value=rng.randrange(1, 10**6)))
+                nonces[s] += 1
+
+    blocks, _ = generate_chain(
+        scratch.config, scratch.current_block, scratch.engine,
+        scratch.state_database, n_blocks, gen=gen,
+    )
+    scratch.stop()
+    return blocks
+
+
+def run_chain(blocks, depth):
+    """Insert blocks at the given pipeline depth; return the full
+    observable signature (per-block hash/root/receipts + head) and the
+    flight records."""
+    chain, _, _ = fresh(depth=depth)
+    for b in blocks:
+        chain.insert_block(b)
+    if chain.pipeline is not None:
+        chain.pipeline.drain()
+    chain.join_tail()
+    sig = []
+    for i in range(1, len(blocks) + 1):
+        b = chain.get_block_by_number(i)
+        receipts = chain.get_receipts(b.hash()) or []
+        sig.append((b.number, b.hash(), b.root,
+                    tuple(r.encode() for r in receipts)))
+    head = chain.current_block.hash()
+    recs = chain.flight_recorder.last(len(blocks))
+    chain.stop()
+    return (tuple(sig), head), recs
+
+
+class TestDeterminismSweep:
+    def test_depth_sweep_conflict_corpus(self):
+        """Bit-exact roots/receipts/head at every depth vs serial, with
+        the pipeline actually speculating (not silently falling back)."""
+        blocks = conflict_corpus(6)
+        baseline, _ = run_chain(blocks, 0)
+        for depth in (1, 2, 3):
+            sig, recs = run_chain(blocks, depth)
+            assert sig == baseline, f"depth {depth} diverged from serial"
+            modes = [r.get("pipeline", {}).get("mode") for r in recs]
+            assert modes.count("spec") >= len(blocks) - 1, modes
+
+    def test_seeded_fuzz_parity(self):
+        for seed in (1234, 99):
+            blocks = conflict_corpus(5, seed=seed)
+            baseline, _ = run_chain(blocks, 0)
+            for depth in (1, 2, 3):
+                sig, _ = run_chain(blocks, depth)
+                assert sig == baseline, f"seed {seed} depth {depth}"
+
+    def test_flight_records_carry_pipeline_stamps(self):
+        blocks = conflict_corpus(6)
+        _, recs = run_chain(blocks, 2)
+        for r in recs:
+            pipe = r.get("pipeline")
+            assert pipe is not None, r
+            assert pipe["depth"] == 2
+            assert pipe["mode"] in ("spec", "serial-fallback")
+            assert 0.0 <= pipe["overlap_fraction"] <= 1.0
+
+
+class TestFailpointDrills:
+    def teardown_method(self):
+        fault.clear_all()
+
+    def _parity_after(self, blocks, chain):
+        chain.join_tail()
+        baseline, _ = run_chain(blocks, 0)
+        sig = []
+        for i in range(1, len(blocks) + 1):
+            b = chain.get_block_by_number(i)
+            receipts = chain.get_receipts(b.hash()) or []
+            sig.append((b.number, b.hash(), b.root,
+                        tuple(r.encode() for r in receipts)))
+        assert (tuple(sig), chain.current_block.hash()) == baseline
+
+    @pytest.mark.parametrize("fp", ["insert/before_recover",
+                                    "insert/before_execute"])
+    def test_submit_stage_failure_surfaces_on_insert(self, fp):
+        """Submit-stage failpoints fire on the caller thread, so the
+        failure surfaces from insert_block itself; disarm + reinsert is
+        bit-exact vs serial."""
+        blocks = conflict_corpus(3)
+        chain, _, _ = fresh(depth=2)
+        chain.insert_block(blocks[0])
+        fault.set_failpoint(fp, "raise*1")
+        with pytest.raises(FailpointError):
+            chain.insert_block(blocks[1])
+        fault.clear_all()
+        chain.insert_block(blocks[1])
+        chain.insert_block(blocks[2])
+        chain.pipeline.drain()
+        self._parity_after(blocks, chain)
+        chain.stop()
+
+    @pytest.mark.parametrize("fp", ["insert/before_commit",
+                                    "insert/before_write"])
+    def test_commit_stage_failure_surfaces_at_drain(self, fp):
+        """Commit-stage failpoints fire in the worker; the error
+        surfaces at the next drain point, downstream speculation is
+        discarded, and reinsertion converges to the serial result."""
+        blocks = conflict_corpus(3)
+        chain, _, _ = fresh(depth=2)
+        fault.set_failpoint(fp, "raise*1")
+        for b in blocks:
+            chain.insert_block(b)
+        with pytest.raises(FailpointError):
+            chain.pipeline.drain()
+        fault.clear_all()
+        # the failed block and its discarded successors were never
+        # inserted; consensus re-delivers them
+        for b in blocks:
+            if not chain.has_block_and_state(b.hash(), b.number):
+                chain.insert_block(b)
+        chain.pipeline.drain()
+        self._parity_after(blocks, chain)
+        chain.stop()
+
+    def test_serial_depth0_fires_the_same_failpoints(self):
+        """The insert/before_* names are shared by both paths, so one
+        drill corpus exercises serial and pipelined inserts alike."""
+        blocks = conflict_corpus(1)
+        chain, _, _ = fresh(depth=0)
+        fault.set_failpoint("insert/before_commit", "raise*1")
+        with pytest.raises(FailpointError):
+            chain.insert_block(blocks[0])
+        fault.clear_all()
+        chain.insert_block(blocks[0])
+        chain.join_tail()
+        assert chain.current_block.hash() == blocks[0].hash()
+        chain.stop()
+
+
+class TestInflightRecordsAndDrains:
+    def teardown_method(self):
+        fault.clear_all()
+
+    def test_insert_recs_keyed_by_hash(self):
+        """Two overlapped inserts keep two distinct in-progress flight
+        records (the single-slot _insert_rec clobbered attribution)."""
+        blocks = conflict_corpus(2)
+        chain, _, _ = fresh(depth=2)
+        fault.set_failpoint("insert/before_commit", "hang")
+        for b in blocks:
+            chain.insert_block(b)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with chain._insert_recs_mu:
+                if len(chain._insert_recs) == 2:
+                    break
+            time.sleep(0.01)
+        with chain._insert_recs_mu:
+            recs = dict(chain._insert_recs)
+        assert set(recs) == {b.hash() for b in blocks}
+        assert recs[blocks[0].hash()]["number"] == 1
+        assert recs[blocks[1].hash()]["number"] == 2
+        fault.clear_all()  # release the parked commit worker
+        chain.pipeline.drain()
+        with chain._insert_recs_mu:
+            assert not chain._insert_recs
+        assert chain.current_block.hash() == blocks[1].hash()
+        chain.stop()
+
+    def test_accept_of_in_flight_block_drains_first(self):
+        """accept() of a block still in the pipeline drains speculation
+        before taking chainmu — no deadlock, no lost commit."""
+        blocks = conflict_corpus(3)
+        chain, _, _ = fresh(depth=2)
+        for b in blocks:
+            chain.insert_block(b)
+        chain.accept(blocks[0])  # no explicit drain: accept must
+        chain.accept(blocks[1])
+        assert chain.last_accepted.hash() == blocks[1].hash()
+        assert chain.current_block.hash() == blocks[2].hash()
+        chain.stop()
+
+    def test_reject_of_in_flight_block_drains_first(self):
+        """reject() drops the losing block's in-memory refs; with the
+        block still in the pipeline it must drain first (outside
+        chainmu) instead of deadlocking against the commit worker."""
+        blocks = conflict_corpus(2)
+        chain, _, _ = fresh(depth=2)
+        for b in blocks:
+            chain.insert_block(b)
+        chain.reject(blocks[1])
+        assert blocks[1].hash() not in chain._blocks
+        with chain._insert_recs_mu:
+            assert not chain._insert_recs
+        chain.stop()
+
+
+class TestSenderCacherBatches:
+    def test_wait_joins_one_batch_by_token(self):
+        gates = {}
+
+        def fake_recover(signer, txs):
+            gates[id(txs)].wait(10)
+
+        cacher = TxSenderCacher(threads=2, batch_recover=fake_recover)
+        txs1, txs2 = [tx(KEYS[0], 0)], [tx(KEYS[1], 0)]
+        ev1, ev2 = threading.Event(), threading.Event()
+        gates[id(txs1)], gates[id(txs2)] = ev1, ev2
+        tok1 = cacher.recover(SIGNER, txs1)
+        tok2 = cacher.recover(SIGNER, txs2)
+        assert tok1 != tok2
+        ev1.set()
+        cacher.wait(tok1)  # returns though batch 2 is still parked
+        with cacher._lock:
+            assert tok2 in cacher._batches
+            assert tok1 not in cacher._batches
+        ev2.set()
+        cacher.wait(tok2)
+        with cacher._lock:
+            assert not cacher._batches
+        cacher.shutdown()
+
+    def test_wait_none_joins_everything(self):
+        cacher = TxSenderCacher(threads=2,
+                                batch_recover=lambda signer, txs: None)
+        t1 = cacher.recover(SIGNER, [tx(KEYS[0], 0)])
+        t2 = cacher.recover(SIGNER, [tx(KEYS[1], 0)])
+        cacher.wait()  # joins both
+        with cacher._lock:
+            assert not cacher._batches
+        cacher.wait(t1)  # completed/pruned tokens are a no-op
+        cacher.wait(t2)
+        assert cacher.recover(SIGNER, []) is None
+        cacher.wait(None)
+        cacher.shutdown()
+
+
+class TestKnobPlumbing:
+    def test_parse_config_round_trip(self):
+        from coreth_tpu.vm.config import parse_config
+
+        assert parse_config(b"{}").insert_pipeline_depth == 0
+        cfg = parse_config(b'{"insert-pipeline-depth": 2}')
+        assert cfg.insert_pipeline_depth == 2
+        with pytest.raises(ValueError, match="insert-pipeline-depth"):
+            parse_config(b'{"insert-pipeline-depth": 4}')
+        with pytest.raises(ValueError, match="insert-pipeline-depth"):
+            parse_config(b'{"insert-pipeline-depth": -1}')
+
+    def test_depth_zero_means_no_pipeline(self):
+        chain, _, _ = fresh(depth=0)
+        assert chain.pipeline is None
+        chain.stop()
+
+    def test_pipeline_rejects_out_of_range_depth(self):
+        from coreth_tpu.core.insert_pipeline import InsertPipeline
+
+        chain, _, _ = fresh(depth=0)
+        with pytest.raises(ValueError):
+            InsertPipeline(chain, depth=4)
+        with pytest.raises(ValueError):
+            InsertPipeline(chain, depth=0)
+        chain.stop()
+
+
+CHILD_PRELUDE = r"""
+import os, sys, threading
+sys.path.insert(0, sys.argv[2])
+from coreth_tpu import fault, params
+from coreth_tpu.consensus.dummy import new_dummy_engine
+from coreth_tpu.core.blockchain import BlockChain, CacheConfig, ChainError
+from coreth_tpu.core.chain_makers import generate_chain
+from coreth_tpu.core.genesis import Genesis, GenesisAccount
+from coreth_tpu.core.types import Signer, Transaction
+from coreth_tpu.crypto.secp256k1 import priv_to_address
+from coreth_tpu.ethdb.sqlitedb import SQLiteDB
+from coreth_tpu.state.database import Database
+from coreth_tpu.trie.triedb import TrieDatabase
+
+KEY = b"\x11" * 32
+ADDR = priv_to_address(KEY)
+DEST = b"\xbb" * 20
+
+def tx(nonce):
+    t = Transaction(type=2, chain_id=43112, nonce=nonce, max_fee=10**12,
+                    max_priority_fee=10**9, gas=21000, to=DEST, value=1000)
+    return Signer(43112).sign(t, KEY)
+
+diskdb = SQLiteDB(sys.argv[1])
+genesis = Genesis(config=params.TEST_CHAIN_CONFIG,
+                  gas_limit=params.CORTINA_GAS_LIMIT,
+                  alloc={ADDR: GenesisAccount(balance=10**22)})
+chain = BlockChain(diskdb,
+                   CacheConfig(commit_interval=4096, insert_pipeline_depth=2),
+                   params.TEST_CHAIN_CONFIG, genesis, new_dummy_engine(),
+                   state_database=Database(TrieDatabase(diskdb)))
+
+def build(n):
+    blocks, _ = generate_chain(
+        chain.config, chain.current_block, chain.engine,
+        chain.state_database, n,
+        gen=lambda i, bg: bg.add_tx(tx(chain.current_block.number + i)))
+    for b in blocks:
+        chain.insert_block(b)
+    chain.pipeline.drain()
+    return blocks
+"""
+
+
+class TestKillInjectionPipelined:
+    """SIGKILL a depth-2 subprocess mid-insert and reopen its database
+    from the files alone: the PR 6 body-before-head ordering and torn-
+    tail repair must hold when the tail FIFO carries TWO pipelined
+    blocks' writes at once."""
+
+    # env-armed before_head hang: the tail worker parks on block 1's
+    # head item while block 2's body+head items (queued by the pipelined
+    # commits) sit behind it in the FIFO. After SIGKILL the disk shows
+    # body 1 durable, nothing canonical, body 2 never written — the
+    # ordering proof across two in-flight blocks.
+    CHILD_ORDERING = CHILD_PRELUDE + r"""
+blocks = build(2)
+import time
+deadline = 60
+while chain._tail_queue.unfinished_tasks > 3 and deadline > 0:
+    time.sleep(0.01); deadline -= 0.01
+print("B1", blocks[0].hash().hex(), flush=True)
+print("B2", blocks[1].hash().hex(), flush=True)
+print("READY", flush=True)
+threading.Event().wait(120)  # parked until SIGKILL
+"""
+
+    # raise*2 on partial_body tears BOTH pipelined blocks' bodies while
+    # their head items land: the head pointer ends up two blocks ahead
+    # of durable data and the boot scan must walk down both.
+    CHILD_TORN = CHILD_PRELUDE + r"""
+blocks = build(2)
+chain.join_tail()
+fault.set_failpoint("chain/tail/partial_body", "raise*2")
+extra = build(2)
+try:
+    chain.join_tail()
+except ChainError:
+    pass
+print("B2", blocks[1].hash().hex(), flush=True)
+print("B3", extra[0].hash().hex(), flush=True)
+print("B4", extra[1].hash().hex(), flush=True)
+print("READY", flush=True)
+threading.Event().wait(120)  # parked until SIGKILL
+"""
+
+    def _run_until_ready(self, script, path, env=None):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        full_env = dict(os.environ)
+        if env:
+            full_env.update(env)
+        proc = subprocess.Popen(
+            [sys.executable, "-c", script, path, repo],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=full_env)
+        lines, deadline = [], time.time() + 300
+        try:
+            while time.time() < deadline:
+                line = proc.stdout.readline()
+                if not line:
+                    break
+                lines.append(line.strip())
+                if line.strip() == "READY":
+                    break
+            else:
+                pytest.fail("child never reached READY")
+            assert "READY" in lines, (lines, proc.stderr.read()[-2000:])
+        finally:
+            proc.kill()  # SIGKILL: no atexit, no close, no flush
+            proc.wait(30)
+        pairs = [l.split() for l in lines]
+        return {p[0]: p[1] for p in pairs
+                if len(p) == 2 and p[0].startswith("B")}
+
+    def _reopen(self, path):
+        from coreth_tpu.ethdb.sqlitedb import SQLiteDB
+
+        diskdb = SQLiteDB(path)
+        genesis = Genesis(
+            config=params.TEST_CHAIN_CONFIG,
+            gas_limit=params.CORTINA_GAS_LIMIT,
+            alloc={priv_to_address(b"\x11" * 32):
+                   GenesisAccount(balance=FUND)},
+        )
+        chain = BlockChain(
+            diskdb, CacheConfig(commit_interval=4096),
+            params.TEST_CHAIN_CONFIG, genesis, new_dummy_engine(),
+            state_database=Database(TrieDatabase(diskdb)),
+        )
+        return chain, diskdb
+
+    def _torn_repairs(self):
+        return default_registry.counter("chain/tail/torn_repairs").count()
+
+    def test_sigkill_mid_pipeline_keeps_write_ordering(self, tmp_path):
+        path = str(tmp_path / "ordering.db")
+        out = self._run_until_ready(
+            self.CHILD_ORDERING, path,
+            env={"CORETH_TPU_FAILPOINTS": "chain/tail/before_head=hang"})
+        h1, h2 = bytes.fromhex(out["B1"]), bytes.fromhex(out["B2"])
+
+        before = self._torn_repairs()
+        chain, diskdb = self._reopen(path)
+        # block 1's body was durable before its head item parked; block
+        # 2's items never left the FIFO — nothing torn, nothing repaired
+        assert rawdb.read_body_rlp(diskdb, 1, h1) is not None
+        assert rawdb.read_body_rlp(diskdb, 2, h2) is None
+        assert chain.current_block.number == 0
+        assert self._torn_repairs() == before
+        chain.stop()
+        diskdb.close()
+
+    def test_sigkill_two_block_torn_tail_repairs_at_reboot(self, tmp_path):
+        path = str(tmp_path / "torn.db")
+        out = self._run_until_ready(self.CHILD_TORN, path)
+        h2 = bytes.fromhex(out["B2"])
+        h3 = bytes.fromhex(out["B3"])
+        h4 = bytes.fromhex(out["B4"])
+
+        from coreth_tpu.ethdb.sqlitedb import SQLiteDB
+
+        probe = SQLiteDB(path)
+        assert rawdb.read_head_block_hash(probe) == h4
+        assert rawdb.read_body_rlp(probe, 3, h3) is None
+        assert rawdb.read_body_rlp(probe, 4, h4) is None
+        probe.close()
+
+        before = self._torn_repairs()
+        chain, diskdb = self._reopen(path)
+        # the scan walked down past BOTH torn pipelined blocks
+        assert chain.current_block.number == 2
+        assert chain.current_block.hash() == h2
+        assert rawdb.read_head_block_hash(diskdb) == h2
+        assert rawdb.read_canonical_hash(diskdb, 3) is None
+        assert rawdb.read_canonical_hash(diskdb, 4) is None
+        assert self._torn_repairs() == before + 1
+        assert chain.state().get_balance(DEST) == 2 * 1000
+        chain.stop()
+        diskdb.close()
