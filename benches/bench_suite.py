@@ -20,6 +20,9 @@ Configs (BASELINE.md "measurable baselines"):
   14 serial vs optimistic-parallel (Block-STM) execution worker sweep
   15 staged insert-pipeline depth sweep {0,1,2,3} — recover/execute of
      block k+1 overlapped with commit/write of block k, CPU legs first
+  16 resident mesh-width sweep {1,2,4,8} — store/arena rows sharded over
+     a device mesh (resident-mesh-devices), CPU default leg first;
+     per-shard lane counts + gather bytes ride the flight records
 
 Each line: {"metric", "value", "unit", "vs_baseline", "config"} where
 vs_baseline compares the accelerated path against the host baseline of
@@ -90,7 +93,7 @@ def _block_insert_rate(resident: bool = False, state_backend: str = "mpt",
                        parallel_workers: int = 0, pipeline_depth: int = 0,
                        template_residency: bool = False,
                        insert_pipeline_depth: int = 0,
-                       per_block: int = 500):
+                       per_block: int = 500, mesh_devices: int = 0):
     """1k-tx block processing: build the blocks, then time insert_block
     (ecrecover via the native batch + EVM + state commit). Returns
     (n_txs, txs_per_sec). resident=True routes the account trie through
@@ -135,7 +138,8 @@ def _block_insert_rate(resident: bool = False, state_backend: str = "mpt",
                     evm_parallel_workers=parallel_workers,
                     resident_pipeline_depth=pipeline_depth,
                     resident_template_residency=template_residency,
-                    insert_pipeline_depth=insert_pipeline_depth),
+                    insert_pipeline_depth=insert_pipeline_depth,
+                    resident_mesh_devices=mesh_devices),
         params.TEST_CHAIN_CONFIG,
         genesis, new_dummy_engine(),
         state_database=Database(TrieDatabase(diskdb)),
@@ -180,6 +184,12 @@ def _block_insert_rate(resident: bool = False, state_backend: str = "mpt",
     dt = time.perf_counter() - t0
     chain.stop()  # drains the write tail, so "write" stamps are final
     _LAST_INSERT_INFO["flight"] = chain.flight_recorder.last()
+    _LAST_INSERT_INFO["shards"] = (
+        chain.mirror.shards if chain.mirror is not None else None)
+    _LAST_INSERT_INFO["shard_lanes"] = (
+        list(getattr(chain.mirror.ex, "last_shard_lanes", []))
+        if chain.mirror is not None and chain.mirror.ex is not None
+        else None)
     shadow = getattr(chain.state_database, "shadow", None)
     _LAST_INSERT_INFO["shadow"] = (
         shadow.status() if shadow is not None else None)
@@ -515,12 +525,16 @@ def _flight_attribution(recs):
     resident: dict = {}
     counters: dict = {}
     overlaps: list = []
+    shards: list = []
     for rec in recs:
         for k, v in rec.get("phases", {}).items():
             phases[k] = phases.get(k, 0.0) + v
         for k, v in rec.get("resident", {}).items():
             if k == "overlap_fraction":  # a ratio, not a duration
                 overlaps.append(v)
+                continue
+            if k == "shards":  # a width, not a duration
+                shards.append(v)
                 continue
             resident[k] = resident.get(k, 0.0) + v
         for k, v in rec.get("counters", {}).items():
@@ -539,6 +553,13 @@ def _flight_attribution(recs):
     h2d = counters.get("resident/h2d_bytes", 0)
     out["h2d_mb"] = round(h2d / 1e6, 2)
     out["h2d_bytes_per_block"] = int(h2d / max(len(recs), 1))
+    # same un-ragged discipline for the mesh columns: an unsharded leg
+    # says shards=1 / zero gather bytes, never a missing key
+    gather = counters.get("resident/gather_bytes", 0)
+    out["gather_mb"] = round(gather / 1e6, 2)
+    out["gather_bytes_per_block"] = int(gather / max(len(recs), 1))
+    if shards:
+        out["shards"] = int(max(shards))
     for k in sorted(phases):
         if phases[k] > 0:
             out["chain_" + k + "_s"] = round(phases[k], 4)
@@ -837,6 +858,86 @@ def bench_15():
           best_rate / serial_rate)
 
 
+def bench_16():
+    """Resident mesh-width sweep (config-16, ROADMAP item 2 landed): the
+    block-insert workload through the mesh-sharded resident mirror at
+    resident-mesh-devices {1,2,4,8}. The CPU default-path leg lands
+    FIRST (the wedge-proof bench.py policy — a wedged tunnel still
+    leaves the host number in the artifact); each width leg then pins
+    the device path (CORETH_TPU_RESIDENT_HOST=0) and reports txs/s plus
+    the per-shard lane counts of its last commit and the summed gather
+    bytes from the flight records. A width the backend cannot host
+    (fewer visible devices — the virtual CPU mesh needs
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 before the first
+    jax call) is recorded as skipped with the typed MeshConfigError
+    message instead of wedging deep inside GSPMD. The workload is
+    scaled down vs config 3 (CORETH_TPU_BENCH_MESH_TXS, default 400)
+    because XLA-CPU sharded compiles dominate at standin widths; the
+    CPU baseline leg uses the SAME scaled workload, so the ratio stays
+    apples-to-apples. vs_baseline = best mesh txs/s / CPU default."""
+    import jax
+
+    n_txs = os.environ.get("CORETH_TPU_BENCH_MESH_TXS", "400")
+    old_txs = os.environ.get("CORETH_TPU_BENCH_BLOCK_TXS")
+    old_host = os.environ.get("CORETH_TPU_RESIDENT_HOST")
+    os.environ["CORETH_TPU_BENCH_BLOCK_TXS"] = n_txs
+    # at least 2 blocks per leg: the dispatch path resolves one commit
+    # behind, so a 1-block run lands its only device commit at stop()
+    # and the flight records show zero gather/h2d bytes
+    per_block = max(50, int(n_txs) // 2)
+    try:
+        _, base_rate = _block_insert_rate(per_block=per_block)
+        sweep: dict = {}
+        best_rate, best_width = 0.0, 0
+        os.environ["CORETH_TPU_RESIDENT_HOST"] = "0"
+        for width in (1, 2, 4, 8):
+            try:
+                _, rate = _block_insert_rate(resident=True,
+                                             mesh_devices=width,
+                                             per_block=per_block)
+            except Exception as e:  # MeshConfigError / planner absent
+                sweep[width] = {"skipped": str(e)}
+                continue
+            attr = _flight_attribution(_LAST_INSERT_INFO.get("flight", []))
+            sweep[width] = {
+                "txs_per_sec": round(rate, 1),
+                "ratio_vs_default": round(rate / base_rate, 3),
+                "shards": _LAST_INSERT_INFO.get("shards"),
+                "last_shard_lanes": _LAST_INSERT_INFO.get("shard_lanes"),
+                "gather_mb": attr.get("gather_mb"),
+                "gather_bytes_per_block": attr.get(
+                    "gather_bytes_per_block"),
+                "h2d_mb": attr.get("h2d_mb"),
+            }
+            if rate > best_rate:
+                best_rate, best_width = rate, width
+    finally:
+        if old_txs is None:
+            os.environ.pop("CORETH_TPU_BENCH_BLOCK_TXS", None)
+        else:
+            os.environ["CORETH_TPU_BENCH_BLOCK_TXS"] = old_txs
+        if old_host is None:
+            os.environ.pop("CORETH_TPU_RESIDENT_HOST", None)
+        else:
+            os.environ["CORETH_TPU_RESIDENT_HOST"] = old_host
+    print(json.dumps({
+        "config": 16,
+        "devices_visible": len(jax.devices()),
+        "n_txs": int(n_txs),
+        "cpu_default_txs_per_sec": round(base_rate, 1),
+        "widths": sweep,
+        "best_width": best_width,
+    }), flush=True)
+    if best_width:
+        _emit(16, "mesh_block_insert_txs_per_sec", best_rate, "txs/s",
+              best_rate / base_rate)
+    else:
+        print(json.dumps({
+            "config": 16,
+            "skipped": "no mesh width ran (see widths for reasons)",
+        }), flush=True)
+
+
 def main():
     from coreth_tpu.utils import enable_compilation_cache
 
@@ -854,7 +955,7 @@ def main():
     watchdog = PhaseWatchdog(
         time.monotonic() + float(os.environ.get("CORETH_TPU_BENCH_WATCHDOG",
                                                 "1800")))
-    picks = [int(a) for a in sys.argv[1:]] or list(range(1, 16))
+    picks = [int(a) for a in sys.argv[1:]] or list(range(1, 17))
     for i in picks:
         # configs 7/9 run bench.py legs under their own phase watchdogs
         # with larger budgets (900s cold warmup); the outer arm must not

@@ -452,6 +452,9 @@ CONSENSUS_FLOAT_PATHS = (
     "coreth_tpu/trie/", "coreth_tpu/rlp.py", "coreth_tpu/evm/gas.py",
     "coreth_tpu/params/", "coreth_tpu/core/types.py",
     "coreth_tpu/bintrie/",
+    # the mesh helpers feed the real commit path now (resident-mesh-
+    # devices): sharded digests are consensus bytes
+    "coreth_tpu/parallel/",
 )
 CONSENSUS_FLOAT_EXCLUDE = (
     "coreth_tpu/trie/resident_mirror.py", "coreth_tpu/trie/planned.py",

@@ -2,10 +2,11 @@
 
 Ingests every per-round bench artifact in the repo root — `BENCH_rNN.json`
 (the config-1 device leg run through the axon tunnel), `BENCH_EARLY_rNN.json`
-(the pre-suite early capture), `BENCH_SUITE_rNN.json` (the 15-config suite)
-— normalizes each measured leg into a (config, metric, provenance) series
-across rounds, and writes `BENCH_TRAJECTORY.json` with median + MAD noise
-bands per series.
+(the pre-suite early capture), `BENCH_SUITE_rNN.json` (the bench-suite
+configs), `MULTICHIP_rNN.json` (the 8-device mesh dryrun, parsed from its
+"dryrun_multichip OK" tail lines) — normalizes each measured leg into a
+(config, metric, provenance) series across rounds, and writes
+`BENCH_TRAJECTORY.json` with median + MAD noise bands per series.
 
 Provenance is the point: a nodes/s number from a live TPU and the same
 metric from the XLA-CPU stand-in (the standing axon-tunnel caveat) are NOT
@@ -83,6 +84,47 @@ def _direction(metric: str, unit: Optional[str]) -> Optional[str]:
 
 # -------------------------------------------------------------- ingestion
 
+# "dryrun_multichip OK" tail lines -> (metric, value) extractors. Counts,
+# not rates: the dryrun proves parity at scale, so the series track its
+# COVERAGE (lanes swept, nodes/segments planned, churn rounds survived);
+# direction is unjudgeable, the sentinel reports them without gating.
+_MULTICHIP_PATTERNS: Tuple[Tuple[str, "re.Pattern"], ...] = (
+    ("multichip_checksum_lanes",
+     re.compile(r"OK: (\d+) lanes over \d+ devices")),
+    # old ("commit of N nodes") and new ("commit — N nodes") tail formats
+    ("multichip_planned_nodes",
+     re.compile(r"sharded planned commit (?:of|—) (\d+) nodes")),
+    ("multichip_planned_segments", re.compile(r"(\d+) segments")),
+    ("multichip_resident_churn_rounds", re.compile(r"(\d+) churn rounds")),
+)
+
+
+def _multichip_points(data: dict, rnd: int,
+                      source: str) -> Tuple[List[dict], List[dict]]:
+    """One MULTICHIP_rNN.json -> ([points], [skipped]). The dryrun runs
+    on the forced-host virtual mesh (the wedged-tunnel reality), so every
+    point is provenance-tagged xla-cpu-standin; a wedged round (rc != 0)
+    records that it TRIED, exactly like an unmeasured bench leg."""
+    config = f"multichip-{data.get('n_devices', '?')}dev"
+    if not data.get("ok") or data.get("rc"):
+        return [], [{
+            "round": rnd, "source": source, "config": config,
+            "metric": "multichip_dryrun",
+            "reason": f"dryrun wedged (rc={data.get('rc')})",
+        }]
+    points: List[dict] = []
+    tail = data.get("tail") or ""
+    for metric, pat in _MULTICHIP_PATTERNS:
+        m = pat.search(tail)
+        if m:
+            points.append({
+                "round": rnd, "source": source, "config": config,
+                "metric": metric, "value": float(m.group(1)),
+                "unit": None, "vs_baseline": None,
+                "provenance": "xla-cpu-standin",
+            })
+    return points, []
+
 
 def _round_of(path: str) -> Optional[int]:
     m = _ROUND_RE.search(os.path.basename(path))
@@ -118,11 +160,15 @@ def _entry_points(entry: dict, rnd: int, source: str,
 
 def load_artifacts(root: str) -> Tuple[List[dict], List[dict]]:
     """Scan [root] for round artifacts; returns (points, skipped). The
-    MULTICHIP_* artifacts and this module's own output are out of scope
-    (different topology / derived data respectively)."""
+    MULTICHIP_PALLAS_* numeric-parity dumps and this module's own output
+    stay out of scope (raw digest words / derived data respectively)."""
     points: List[dict] = []
     skipped: List[dict] = []
-    for path in sorted(glob.glob(os.path.join(root, "BENCH_*.json"))):
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_*.json")))
+    paths += sorted(p for p in glob.glob(
+        os.path.join(root, "MULTICHIP_*.json"))
+        if not os.path.basename(p).startswith("MULTICHIP_PALLAS"))
+    for path in paths:
         name = os.path.basename(path)
         if name == OUTPUT:
             continue
@@ -136,7 +182,11 @@ def load_artifacts(root: str) -> Tuple[List[dict], List[dict]]:
             skipped.append({"round": None, "source": name,
                             "reason": f"unreadable artifact: {e}"})
             continue
-        if name.startswith("BENCH_SUITE_"):
+        if name.startswith("MULTICHIP_"):
+            p, s = _multichip_points(data, rnd, name)
+            points += p
+            skipped += s
+        elif name.startswith("BENCH_SUITE_"):
             platform = data.get("platform")
             results = data.get("results") or []
             # a metric-less companion dict (config 10's cold/host_mode

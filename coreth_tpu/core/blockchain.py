@@ -166,6 +166,12 @@ class CacheConfig:
     # arenas + store resident, so uploads carry only fresh leaf content.
     # Excludes pipelining (the per-commit absorb IS a sync)
     resident_template_residency: bool = False
+    # mesh-sharded resident commits: shard the mirror's digest store +
+    # row arenas over this many devices (0 = unsharded). Valid widths
+    # 1/2/4/8 (must divide the 16-lane planner bucket); a device wedge
+    # demotes mesh -> single-device resident -> host, each rung
+    # bit-exact
+    resident_mesh_devices: int = 0
     # deadline (seconds) for join_tail / acceptor-queue joins; on expiry
     # they raise TailStalled instead of blocking forever. 0 = unbounded
     tail_join_timeout: float = 0.0
@@ -197,7 +203,7 @@ class CacheConfig:
 _FLIGHT_COUNTERS = (
     "state/snap/hits", "state/snap/misses", "state/snap/generating",
     "resident/plan_cache/hits", "resident/plan_cache/misses",
-    "resident/h2d_bytes",
+    "resident/h2d_bytes", "resident/gather_bytes",
     "trie/keccak/batches", "trie/keccak/batch_msgs",
 )
 _FLIGHT_TIMERS = (
@@ -672,6 +678,7 @@ class BlockChain:
             pipeline_depth=self.cache_config.resident_pipeline_depth,
             template_residency=(
                 self.cache_config.resident_template_residency),
+            mesh_devices=self.cache_config.resident_mesh_devices,
         )
         self.mirror.on_takeover = self._on_mirror_takeover
         self.state_database.mirror = self.mirror
@@ -980,6 +987,12 @@ class BlockChain:
                 for n in _FLIGHT_TIMERS
                 if (d := _metrics.timer(n).total() - timers0[n]) > 0.0
             }
+            if mirror is not None:
+                # un-ragged across configs (the PR 12 h2d_bytes=0
+                # discipline): unsharded commits emit an explicit
+                # shards=1, and gather_bytes=0 rides the counters dict
+                rec["resident"]["shards"] = mirror.shards
+                _metrics.gauge("resident/shards").update(mirror.shards)
             if mirror is not None and mirror.last_overlap_fraction > 0.0:
                 # overlap of the most recently DRAINED pipelined commit
                 # (drains lag dispatch by up to the window depth, so

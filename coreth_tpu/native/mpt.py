@@ -362,6 +362,8 @@ def load_inc():
         lib.mpt_inc_res_absorb.argtypes = [ctypes.c_void_p, _u8p, _u8p]
         lib.mpt_inc_mark_all_dirty.restype = None
         lib.mpt_inc_mark_all_dirty.argtypes = [ctypes.c_void_p]
+        lib.mpt_inc_res_reset.restype = None
+        lib.mpt_inc_res_reset.argtypes = [ctypes.c_void_p]
         lib.mpt_inc_checkpoint.restype = None
         lib.mpt_inc_checkpoint.argtypes = [ctypes.c_void_p]
         lib.mpt_inc_discard_checkpoint.restype = None
@@ -668,6 +670,18 @@ class IncrementalTrie:
         self._lib.mpt_inc_mark_all_dirty(self._h)
         self._mode = "host"
         return self.commit_cpu(threads=threads)
+
+    def rebase_residency(self) -> None:
+        """Mesh-ladder demotion seam: abandon every device-side
+        assignment (store slots, arena rows) and mark the whole trie
+        dirty, then UNPIN the commit mode. The next resident/template
+        commit re-pins its mode and re-uploads every row — exactly the
+        first commit after construction — so residency can rebuild on a
+        FRESH executor. Bit-exact by construction: all rows are fresh,
+        so no delta patch ever reads the abandoned executor's store
+        (every "old" term is the zero sentinel)."""
+        self._lib.mpt_inc_res_reset(self._h)
+        self._mode = None
 
     def commit_resident(self, executor):
         """Device-resident commit: plan, ship fresh rows + patch tables,

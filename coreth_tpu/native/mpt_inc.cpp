@@ -1247,6 +1247,37 @@ void mpt_inc_res_absorb(void* h, const uint8_t* dig, uint8_t* out_root32) {
   res_absorb_digests(*t, dig);
 }
 
+// Mesh-ladder demotion seam: abandon EVERY device-side assignment (store
+// slots, arena rows, both free lists) and mark the whole trie dirty, so
+// the next resident plan classifies EVERY row as fresh and re-uploads it
+// — exactly the first commit after construction — onto a brand-new
+// executor. Nothing from the old executor's store ever enters a delta
+// patch again (fresh rows start with zeroed holes and old = the zero
+// sentinel), which is what makes the mesh -> single-device rebuild of
+// trie/resident_mirror.py bit-exact. The undo journal stores VALUES and
+// rollback replays them through the normal updater, so no rolled-back
+// node can resurface with a stale pre-reset row/slot.
+void mpt_inc_res_reset(void* h) {
+  Inc* t = (Inc*)h;
+  walk_all(t->root, [](INode* n) {
+    n->dirty = true;
+    n->structural = true;
+    n->enc_len = -1;
+    n->lane = -1;
+    n->slot = -1;
+    n->row = -1;
+    n->row_blocks = 0;
+  });
+  t->next_slot = 2;
+  t->free_slots.clear();
+  for (auto& c : t->rcls) {
+    c.next_row = 1;
+    c.free_rows.clear();
+    c.fresh_rows.clear();
+    c.fresh_idx.clear();
+  }
+}
+
 // Device-failure takeover seam: mark EVERY node dirty so the next host
 // plan re-hashes the whole trie. After a resident (device-store) commit
 // history the host digest cache is stale; a full host rehash

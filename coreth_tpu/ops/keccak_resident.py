@@ -187,14 +187,48 @@ class ResidentExecutor:
         self.last_transfers = 0
         self.last_dispatches = 0
         self.last_cache_hit = False
+        # mesh diagnostics, explicitly zeroed when unsharded so flight-
+        # record keys stay un-ragged: modeled cross-shard digest-gather
+        # bytes of the last commit, and its lanes per store shard
+        self.last_gather_bytes = 0
+        self.last_shard_lanes: list = []
         # full digest matrix of the last run (lazy, includes the zero-
         # sentinel row 0) — template residency absorbs it host-side
         self.last_dig: Optional[jax.Array] = None
+
+    @property
+    def shards(self) -> int:
+        """Mesh shards holding the resident state (1 = unsharded)."""
+        return self._row_mult
 
     def _pin(self, arr: jax.Array) -> jax.Array:
         if self.sharding is None:
             return arr
         return jax.device_put(arr, self.sharding)
+
+    def _note_collectives(self, export) -> None:
+        """Per-commit collective accounting for the flight record. The
+        mesh's only cross-shard traffic is the digest all-gather back to
+        the replicated dig matrix (store/arena scatters stay shard-local
+        by row layout), modeled as (shards-1)/shards of every lane's
+        32-byte digest. lanes-per-shard comes from each lane's store
+        slot, whose contiguous row blocks are what NamedSharding
+        partitions. Unsharded commits record the explicit zeros so
+        flight-record keys stay un-ragged across configs."""
+        from ..metrics import default_registry
+
+        total_lanes = int(export["total_lanes"])
+        n = self._row_mult
+        if n > 1:
+            self.last_gather_bytes = total_lanes * 32 * (n - 1) // n
+            per = max(1, self.store.shape[0] // n)
+            owner = np.minimum(export["lane_slot"] // per, n - 1)
+            self.last_shard_lanes = np.bincount(owner, minlength=n).tolist()
+        else:
+            self.last_gather_bytes = 0
+            self.last_shard_lanes = [total_lanes]
+        default_registry.counter("resident/gather_bytes").inc(
+            self.last_gather_bytes)
 
     # ---- ownership: slot/row numbering is per-trie, so a second trie
     # sharing this executor would silently corrupt both stores ----
@@ -270,8 +304,22 @@ class ResidentExecutor:
         narena = len(classes)
         cls_pos = {c: i for i, c in enumerate(classes)}
 
-        @functools.partial(jax.jit,
-                           donate_argnums=tuple(range(1 + narena)))
+        jit_kwargs = dict(donate_argnums=tuple(range(1 + narena)))
+        if self.sharding is not None:
+            # pjit discipline for chained commits: pin matching in/out
+            # axis_resources so the store and arenas stay row-sharded
+            # edge to edge across every commit — nothing reshards
+            # between dispatches — while the per-commit uploads and the
+            # dig matrix stay replicated (patches may read any lane).
+            # The only cross-shard traffic left is the digest gather.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(self.sharding.mesh, PartitionSpec())
+            res = (self.sharding,) * (1 + narena)
+            jit_kwargs.update(in_shardings=res + (repl, repl),
+                              out_shardings=res + (repl,))
+
+        @functools.partial(jax.jit, **jit_kwargs)
         def fused(store, *rest):
             arenas = list(rest[:narena])
             rows_packed, aux = rest[narena], rest[narena + 1]
@@ -413,6 +461,7 @@ class ResidentExecutor:
 
             default_registry.counter("resident/h2d_bytes").inc(
                 self.h2d_bytes)
+            self._note_collectives(export)
         return self.last_root
 
     # ---- one commit ----
@@ -489,6 +538,7 @@ class ResidentExecutor:
         from ..metrics import default_registry
 
         default_registry.counter("resident/h2d_bytes").inc(self.h2d_bytes)
+        self._note_collectives(export)
         return self.last_root
 
     @staticmethod

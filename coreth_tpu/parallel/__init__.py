@@ -30,10 +30,49 @@ from ..ops.keccak_jax import (
 )
 
 
+class MeshConfigError(ValueError):
+    """A mesh request that can never produce a working sharded commit —
+    raised at mesh construction with an actionable message instead of
+    surfacing as an opaque shape/device error deep inside shard_map or
+    GSPMD partitioning (the resident-mesh-devices knob's fail-fast)."""
+
+
+# the planner buckets every segment's lane count to a multiple of this
+# (ops/keccak_resident._pow2_bucket floor; mpt_inc.cpp round_lanes), so a
+# mesh width must divide it for lanes to split evenly across shards
+LANE_BUCKET = 16
+
+
+def _check_width(n: int, what: str) -> None:
+    devs = jax.devices()
+    if n <= 0:
+        raise MeshConfigError(
+            f"{what} must be a positive device count (got {n})")
+    if n > len(devs):
+        raise MeshConfigError(
+            f"{what} requests {n} devices but only {len(devs)} JAX "
+            f"device(s) are visible on backend "
+            f"{jax.default_backend()!r}; lower the width (e.g. the "
+            f"resident-mesh-devices knob) or, for a virtual CPU mesh, "
+            f"set XLA_FLAGS=--xla_force_host_platform_device_count={n} "
+            f"before the first jax call")
+    if LANE_BUCKET % n != 0:
+        raise MeshConfigError(
+            f"{what} of {n} does not divide the {LANE_BUCKET}-lane "
+            f"planner bucket: segment lane counts are multiples of "
+            f"{LANE_BUCKET}, so shards would be uneven — use a "
+            f"power-of-two width <= {LANE_BUCKET}")
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = "batch") -> Mesh:
-    """1-D mesh over the first n devices (all by default)."""
+    """1-D mesh over the first n devices (all by default).
+
+    Raises MeshConfigError (not an opaque shard_map failure) when the
+    requested width exceeds the visible devices or does not divide the
+    planner's lane bucketing."""
     devs = jax.devices()
     if n_devices is not None:
+        _check_width(int(n_devices), "mesh width")
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (axis,))
 
@@ -55,11 +94,14 @@ def make_mesh_2d(n_hosts: int, chips_per_host: int,
     virtual meshes (where this helper validates sharding LAYOUTS — on a
     real multi-host slice, prefer mesh_utils.create_hybrid_device_mesh
     with explicit per-host groupings)."""
+    if n_hosts <= 0 or chips_per_host <= 0:
+        raise MeshConfigError(
+            f"2-D mesh extents must be positive (got {n_hosts} hosts x "
+            f"{chips_per_host} chips/host)")
     want = n_hosts * chips_per_host
-    devs = jax.devices()
-    if len(devs) < want:
-        raise ValueError(f"need {want} devices, have {len(devs)}")
-    devs = devs[:want]
+    _check_width(want, f"2-D mesh ({n_hosts} hosts x {chips_per_host} "
+                       f"chips/host)")
+    devs = jax.devices()[:want]
     try:
         from jax.experimental import mesh_utils
 

@@ -78,7 +78,8 @@ class ResidentAccountMirror:
                  cpu_threads: Optional[int] = None,
                  prefer_host: Optional[bool] = None,
                  pipeline_depth: int = 0,
-                 template_residency: bool = False):
+                 template_residency: bool = False,
+                 mesh_devices: int = 0):
         import os
 
         if cpu_threads is None or int(cpu_threads) <= 0:
@@ -116,9 +117,21 @@ class ResidentAccountMirror:
 
             default_registry.counter("state/resident/cpu_fastpath").inc(1)
         elif executor is None:
-            from ..ops.keccak_resident import ResidentExecutor
+            if mesh_devices and int(mesh_devices) > 0:
+                # mesh mode (knob resident-mesh-devices): store and
+                # arena rows sharded P('batch', None) across the first
+                # [mesh_devices] devices. MeshConfigError propagates —
+                # an impossible width is an actionable config failure,
+                # not a reason to fall back unsharded silently.
+                from ..parallel import make_mesh, resident_executor_over_mesh
 
-            executor = ResidentExecutor()
+                executor = resident_executor_over_mesh(
+                    make_mesh(int(mesh_devices)))
+            else:
+                from ..ops.keccak_resident import ResidentExecutor
+
+                executor = ResidentExecutor()
+        self.mesh_devices = int(mesh_devices or 0)
         self.ex = executor  # None in host mode unless the caller passed one
         # cross-commit device pipelining: up to [pipeline_depth] verified
         # commits may stay IN FLIGHT on the device, each optimistically
@@ -218,8 +231,79 @@ class ResidentAccountMirror:
                     return self.trie.commit_resident_timed(
                         self.ex, self.device_timeout)
                 except DeviceWedgedError as e:
-                    self._take_over_host(str(e))
-                    return self.trie.commit_cpu(threads=self._cpu_threads)
+                    # degradation left the trie settled at the same
+                    # state; re-enter to return its root from whichever
+                    # rung we landed on. Bounded: each _degrade moves
+                    # strictly down (mesh -> single device -> host) and
+                    # the host path cannot wedge.
+                    self._degrade(str(e))
+                    return self._commit_root()
+
+    def _degrade(self, why: str) -> None:  # guarded-by: _lock
+        """Walk ONE rung down the device degradation ladder:
+        mesh-sharded resident -> single-device resident -> host. Each
+        step is bit-exact — the mesh rung re-proves its image against
+        the host oracle root before keeping commits on the device, and
+        the host rung IS the oracle."""
+        if not self._demote_mesh(why):
+            self._take_over_host(why)
+
+    def _demote_mesh(self, why: str) -> bool:  # guarded-by: _lock
+        """Mesh ladder rung: a wedge on a >1-shard executor first tries
+        to rebuild residency on a SINGLE device before abandoning the
+        device path entirely. Sequence: host-oracle rehash (also the
+        warm digest cache later exports/spot-checks read), then abandon
+        every device-side row/slot assignment (rebase_residency), then
+        a full recommit on a fresh unsharded executor, bit-exact
+        against the oracle root. Returns False — caller escalates to
+        the host takeover, which is safe from any pinned mode — when
+        already at the bottom device rung or when the rebuild itself
+        fails or diverges."""
+        if self.host_mode or self.ex is None:
+            return False
+        if int(getattr(self.ex, "shards", 1)) <= 1:
+            return False  # bottom device rung: only the host is left
+        from ..log import get_logger
+        from ..metrics import default_registry
+
+        get_logger("state").error(
+            "mesh resident backend wedged (%s) — demoting %d-shard mesh "
+            "to a single device: host oracle rehash, fresh residency, "
+            "bit-exact recommit of %d nodes",
+            why, int(getattr(self.ex, "shards", 1)), self.trie.num_nodes)
+        try:
+            host_root = self.trie.rehash_host(threads=self._cpu_threads)
+            from ..ops.keccak_resident import ResidentExecutor
+
+            ex = ResidentExecutor()
+            ex.pipeline_depth = self.pipeline_depth
+            self.trie.rebase_residency()
+            self.ex = ex
+            if self.template:
+                root = self.trie.commit_template(ex, self.device_timeout)
+            else:
+                root = self.trie.commit_resident_timed(
+                    ex, self.device_timeout)
+            if root != host_root:
+                raise MirrorError(
+                    "single-device recommit root does not match the "
+                    "host oracle")
+        except BaseException as rebuild_err:
+            # wedged again or diverged mid-rebuild: hand the SAME wedge
+            # to the host takeover (its rehash works from any mode the
+            # failed rebuild left pinned)
+            default_registry.counter(
+                "state/resident/mesh_demotion_failures").inc(1)
+            get_logger("state").error(
+                "single-device rebuild failed (%s) — escalating the "
+                "wedge to the host takeover", rebuild_err)
+            return False
+        default_registry.counter("state/resident/mesh_demotions").inc(1)
+        # device-era delta marks predate the demotion — same full-image
+        # discipline as the host takeover
+        self._export_degraded = True
+        self._dirty_since_export = True
+        return True
 
     def _take_over_host(self, why: str) -> None:  # guarded-by: _lock
         """One-way device -> host switch: rebuild the full host digest
@@ -256,6 +340,15 @@ class ResidentAccountMirror:
 
                 count_drop("state/resident/takeover_hook_error")
 
+    @property
+    def shards(self) -> int:
+        """Mesh shards behind the CURRENT ladder rung (1 on the host,
+        on a single device, or after a mesh demotion) — the flight
+        record's un-ragged `resident/shards`."""
+        if self.host_mode or self.ex is None:
+            return 1
+        return int(getattr(self.ex, "shards", 1))
+
     # ---- cross-commit device pipelining ----------------------------------
 
     def _pipelining(self) -> bool:
@@ -289,7 +382,7 @@ class ResidentAccountMirror:
             self._drain_on_host(str(e))
             self.trie.checkpoint()
             self.trie.update(updates)
-            return self.trie.commit_cpu(threads=self._cpu_threads)
+            return self._commit_root()  # whichever rung the drain landed on
         self._inflight.append({
             "key": key, "expected": expected, "resolve": resolve,
             "t_dispatch": time.monotonic()})
@@ -343,15 +436,18 @@ class ResidentAccountMirror:
 
     def _drain_on_host(self, why: str) -> None:  # guarded-by: _lock
         """A device wedge surfaced while the pipeline window was
-        non-empty: take over on the host, then recompute every in-flight
-        commit's root there — rewind through the window's scopes and
-        replay each batch with a serial host commit, comparing against
-        the header root it was recorded under. Bit-exact: the host
-        hasher is the oracle the device was checked against all along
-        (the PR 6 soft landing, now window-deep)."""
+        non-empty: degrade one ladder rung (mesh -> single device, or
+        device -> host — the name predates the mesh rung; either way
+        the HOST oracle root anchors the landing), then recompute every
+        in-flight commit's root serially on the landing rung — rewind
+        through the window's scopes and replay each batch, comparing
+        against the header root it was recorded under. Bit-exact: the
+        mesh demotion re-proved its image against the host oracle, and
+        the host hasher is the oracle the device was checked against
+        all along (the PR 6 soft landing, now window-deep)."""
         window, self._inflight = list(self._inflight), []
         self._pipeline_gauge()
-        self._take_over_host(why)
+        self._degrade(why)
         for _ in window:
             self._applied.pop()
             self.trie.rollback()
@@ -360,7 +456,7 @@ class ResidentAccountMirror:
             self.trie.checkpoint()
             self.trie.update(self._batch[ent["key"]])
             self._dirty_since_export = True
-            root = self.trie.commit_cpu(threads=self._cpu_threads)
+            root = self._commit_root()
             if root != ent["expected"]:
                 # the host oracle disagrees with the recorded header
                 # root: the BLOCK was wrong, not the device — drop it
@@ -477,10 +573,13 @@ class ResidentAccountMirror:
                         self.device_timeout, "spot-check store readback")
                 self.trie.absorb_store(store_np)
         except DeviceWedgedError as e:
-            # not a divergence: the ladder's failure mode. Take over like
-            # any wedged commit; the host root is authoritative now.
-            self._take_over_host(str(e))
-            self.trie.commit_cpu(threads=self._cpu_threads)
+            # not a divergence: the ladder's failure mode. Degrade like
+            # any wedged commit; a mesh demotion already verified the
+            # rebuilt image against the host oracle root, and the host
+            # rung IS the oracle.
+            self._degrade(str(e))
+            if self.host_mode:
+                self.trie.commit_cpu(threads=self._cpu_threads)
             return True
         digs, blob, off = self.trie.export_nodes(delta=False)
         self._export_degraded = True
@@ -939,8 +1038,12 @@ class ResidentAccountMirror:
                             self.device_timeout, "store readback")
                     self.trie.absorb_store(store_np)
             except DeviceWedgedError as e:
-                self._take_over_host(str(e))
-                self.trie.commit_cpu(threads=self._cpu_threads)
+                self._degrade(str(e))
+                if self.host_mode:
+                    self.trie.commit_cpu(threads=self._cpu_threads)
+                # else: the mesh demotion's host-oracle rehash left the
+                # digest cache current for this settled state — the
+                # export below reads it directly
         try:
             digs, blob, off = self.trie.export_nodes(
                 delta=not self._export_degraded)

@@ -133,6 +133,13 @@ class Config:
     # while the device keeps row arenas + digest store resident, so
     # uploads carry only fresh leaf content. Excludes pipelining
     resident_template_residency: bool = False
+    # mesh-sharded resident commits: shard the digest store + row arenas
+    # P('batch', None) over this many devices (the promoted MULTICHIP
+    # dryrun path). 0 = unsharded single-device executor (default);
+    # widths must divide the 16-lane planner bucket, so 1/2/4/8. A wedge
+    # demotes mesh -> single-device resident -> host, each rung
+    # bit-exact vs the host oracle
+    resident_mesh_devices: int = 0
     # native CPU hasher worker threads (plan execute + batch keccak);
     # 0 = auto (env CORETH_TPU_CPU_THREADS, else min(16, cores))
     cpu_threads: int = 0
@@ -299,6 +306,11 @@ class Config:
             raise ValueError(
                 f"resident-template-residency must be a boolean "
                 f"(got {self.resident_template_residency!r})")
+        if self.resident_mesh_devices not in (0, 1, 2, 4, 8):
+            raise ValueError(
+                f"resident-mesh-devices must be one of 0, 1, 2, 4, 8 "
+                f"(widths must divide the 16-lane planner bucket; got "
+                f"{self.resident_mesh_devices})")
         if not (0 <= self.evm_parallel_workers <= 64):
             raise ValueError(
                 f"evm-parallel-workers must be in [0, 64] "

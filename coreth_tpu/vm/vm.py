@@ -227,6 +227,7 @@ class VM:
                 insert_pipeline_depth=full.insert_pipeline_depth,
                 resident_template_residency=(
                     full.resident_template_residency),
+                resident_mesh_devices=full.resident_mesh_devices,
                 tail_join_timeout=full.tail_join_timeout,
                 state_backend=full.state_backend,
                 shadow_check_interval=full.shadow_check_interval,

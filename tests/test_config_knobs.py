@@ -206,6 +206,22 @@ def test_metrics_and_log_level_applied():
         vm.shutdown()
 
 
+def test_resident_mesh_devices_knob():
+    from coreth_tpu.vm.config import parse_config
+
+    # the knob flows vm/config -> CacheConfig (the mirror itself only
+    # boots when the resident trie is enabled)
+    vm = boot_vm(**{"resident-mesh-devices": 2})
+    assert vm.blockchain.cache_config.resident_mesh_devices == 2
+    vm.shutdown()
+    # every legal width parses; 3 can never split the 16-lane buckets
+    for ok in (0, 1, 2, 4, 8):
+        parse_config(json.dumps({"resident-mesh-devices": ok}).encode())
+    with pytest.raises(ValueError,
+                       match="resident-mesh-devices must be one of"):
+        parse_config(json.dumps({"resident-mesh-devices": 3}).encode())
+
+
 def test_validate_rejects_bad_combinations():
     from coreth_tpu.vm.config import parse_config
 

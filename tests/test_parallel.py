@@ -66,6 +66,50 @@ class TestCommitStep:
         assert int(np.asarray(checksum)) == int(np.sum(out, dtype=np.uint32))
 
 
+class TestMeshConfigErrors:
+    """The resident-mesh-devices fail-fast: impossible widths must raise
+    the typed MeshConfigError with an actionable message at construction,
+    never an opaque shape/device error deep inside GSPMD."""
+
+    def test_width_past_visible_devices_names_the_fix(self):
+        from coreth_tpu.parallel import MeshConfigError, make_mesh
+
+        n = len(jax.devices())
+        with pytest.raises(MeshConfigError) as ei:
+            make_mesh(16 if n < 16 else n * 2)
+        msg = str(ei.value)
+        assert f"only {n} JAX device(s) are visible" in msg
+        assert "XLA_FLAGS=--xla_force_host_platform_device_count" in msg
+        assert "resident-mesh-devices" in msg
+
+    def test_width_must_divide_lane_bucket(self):
+        from coreth_tpu.parallel import MeshConfigError, make_mesh
+
+        with pytest.raises(MeshConfigError) as ei:
+            make_mesh(3)  # 3 visible devices exist, but 16 % 3 != 0
+        assert "does not divide the 16-lane planner bucket" in str(ei.value)
+
+    @pytest.mark.parametrize("bad", [0, -1])
+    def test_width_must_be_positive(self, bad):
+        from coreth_tpu.parallel import MeshConfigError, make_mesh
+
+        with pytest.raises(MeshConfigError, match="positive device count"):
+            make_mesh(bad)
+
+    def test_2d_mesh_extents_must_be_positive(self):
+        from coreth_tpu.parallel import MeshConfigError, make_mesh_2d
+
+        with pytest.raises(MeshConfigError, match="positive"):
+            make_mesh_2d(0, 2)
+
+    def test_mesh_config_error_is_a_value_error(self):
+        # callers that predate the typed error (CacheConfig plumbing,
+        # bench sweeps) catch ValueError and keep working
+        from coreth_tpu.parallel import MeshConfigError
+
+        assert issubclass(MeshConfigError, ValueError)
+
+
 class TestMultiHostMesh:
     """2-D (host, chip) mesh — the multi-host deployment layout: lanes
     shard over BOTH axes (P(('host','batch'))), so on real hardware the

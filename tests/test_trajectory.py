@@ -67,9 +67,57 @@ class TestIngestion:
         assert len(skipped) == 1
         assert "wedged" in skipped[0]["reason"]
 
-    def test_multichip_and_own_output_out_of_scope(self, tmp_path):
-        (tmp_path / "MULTICHIP_r01.json").write_text("{}")
+    def test_own_output_out_of_scope(self, tmp_path):
         (tmp_path / OUTPUT).write_text('{"schema": "stale"}')
+        points, skipped = load_artifacts(str(tmp_path))
+        assert points == [] and skipped == []
+
+    def test_multichip_ok_round_parses_coverage_series(self, tmp_path):
+        # the r04+ tail shape: checksum sweep + sharded planned commit
+        # (new "— N nodes" wording) + resident churn line
+        (tmp_path / "MULTICHIP_r04.json").write_text(json.dumps({
+            "round": 4, "ok": True, "rc": 0, "n_devices": 8,
+            "tail": "dryrun_multichip OK: 1024 lanes over 8 devices\n"
+                    "sharded planned commit — 26862 nodes, 17 segments\n"
+                    "RESIDENT executor sharded over 8 devices — 3 churn "
+                    "rounds + rollback bit-exact vs host oracle"}))
+        points, skipped = load_artifacts(str(tmp_path))
+        assert skipped == []
+        got = {p["metric"]: p["value"] for p in points}
+        assert got == {"multichip_checksum_lanes": 1024.0,
+                       "multichip_planned_nodes": 26862.0,
+                       "multichip_planned_segments": 17.0,
+                       "multichip_resident_churn_rounds": 3.0}
+        assert all(p["provenance"] == "xla-cpu-standin" for p in points)
+        assert all(p["config"] == "multichip-8dev" for p in points)
+        # counts have no judgeable direction: reported, never gated
+        out = build_trajectory(points, [])
+        assert out["regressions"] == []
+        for s in out["series"].values():
+            assert s["status"] in ("short", "unjudged")
+
+    def test_multichip_old_tail_format_still_parses(self, tmp_path):
+        # the r02-era wording ("commit of N nodes")
+        (tmp_path / "MULTICHIP_r02.json").write_text(json.dumps({
+            "round": 2, "ok": True, "rc": 0, "n_devices": 8,
+            "tail": "sharded planned commit of 412 nodes matches the "
+                    "host oracle root"}))
+        points, _ = load_artifacts(str(tmp_path))
+        assert {p["metric"] for p in points} == {"multichip_planned_nodes"}
+        assert points[0]["value"] == 412.0
+
+    def test_multichip_wedged_round_is_skipped_not_a_point(self, tmp_path):
+        (tmp_path / "MULTICHIP_r01.json").write_text(json.dumps({
+            "round": 1, "ok": False, "rc": 124, "n_devices": 8,
+            "tail": ""}))
+        points, skipped = load_artifacts(str(tmp_path))
+        assert points == []
+        assert len(skipped) == 1
+        assert skipped[0]["reason"] == "dryrun wedged (rc=124)"
+
+    def test_multichip_pallas_dumps_stay_out_of_scope(self, tmp_path):
+        # numeric-parity dumps share the prefix but aren't dryrun rounds
+        (tmp_path / "MULTICHIP_PALLAS_r03.json").write_text('{"raw": 1}')
         points, skipped = load_artifacts(str(tmp_path))
         assert points == [] and skipped == []
 
