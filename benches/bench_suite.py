@@ -93,7 +93,8 @@ def _block_insert_rate(resident: bool = False, state_backend: str = "mpt",
                        parallel_workers: int = 0, pipeline_depth: int = 0,
                        template_residency: bool = False,
                        insert_pipeline_depth: int = 0,
-                       per_block: int = 500, mesh_devices: int = 0):
+                       per_block: int = 500, mesh_devices: int = 0,
+                       db_verify_on_read: bool = False):
     """1k-tx block processing: build the blocks, then time insert_block
     (ecrecover via the native batch + EVM + state commit). Returns
     (n_txs, txs_per_sec). resident=True routes the account trie through
@@ -139,7 +140,8 @@ def _block_insert_rate(resident: bool = False, state_backend: str = "mpt",
                     resident_pipeline_depth=pipeline_depth,
                     resident_template_residency=template_residency,
                     insert_pipeline_depth=insert_pipeline_depth,
-                    resident_mesh_devices=mesh_devices),
+                    resident_mesh_devices=mesh_devices,
+                    db_verify_on_read=db_verify_on_read),
         params.TEST_CHAIN_CONFIG,
         genesis, new_dummy_engine(),
         state_database=Database(TrieDatabase(diskdb)),
@@ -938,6 +940,41 @@ def bench_16():
         }), flush=True)
 
 
+def bench_17():
+    """Verify-on-read overhead A/B (config-17, storage fault armor):
+    the config-3 insert workload with db-verify-on-read off (baseline)
+    then on — every hash-addressed payload read back from disk pays a
+    keccak recompute at the storage boundary. Both legs are CPU and the
+    baseline lands first (the wedge-proof bench.py policy). The armor
+    leg also reports the db/verify_failures delta, which must be 0 on a
+    clean run: a nonzero delta means the bench corrupted its own reads
+    and the ratio is measuring error handling, not verification.
+    vs_baseline = verify-on txs/s / verify-off txs/s — the price of the
+    armor, expected close to 1.0 on the MemoryDB insert path (inserts
+    are write-heavy; the verify tax lands on the read side)."""
+    from coreth_tpu.core import rawdb
+    from coreth_tpu.metrics import default_registry
+
+    _, off_rate = _block_insert_rate()
+    failures0 = default_registry.counter("db/verify_failures").count()
+    try:
+        _, on_rate = _block_insert_rate(db_verify_on_read=True)
+    finally:
+        # the knob mounts into a process-wide rawdb flag at chain boot;
+        # leave the suite's later configs unarmored
+        rawdb.set_verify_on_read(False)
+    failures = default_registry.counter("db/verify_failures").count() \
+        - failures0
+    print(json.dumps({
+        "config": 17,
+        "verify_off_txs_per_sec": round(off_rate, 1),
+        "verify_on_txs_per_sec": round(on_rate, 1),
+        "verify_failures": failures,
+    }), flush=True)
+    _emit(17, "verify_on_read_block_insert_txs_per_sec", on_rate, "txs/s",
+          on_rate / off_rate)
+
+
 def main():
     from coreth_tpu.utils import enable_compilation_cache
 
@@ -955,7 +992,7 @@ def main():
     watchdog = PhaseWatchdog(
         time.monotonic() + float(os.environ.get("CORETH_TPU_BENCH_WATCHDOG",
                                                 "1800")))
-    picks = [int(a) for a in sys.argv[1:]] or list(range(1, 17))
+    picks = [int(a) for a in sys.argv[1:]] or list(range(1, 18))
     for i in picks:
         # configs 7/9 run bench.py legs under their own phase watchdogs
         # with larger budgets (900s cold warmup); the outer arm must not

@@ -750,6 +750,11 @@ class FailpointHygieneRule(Rule):
 # core/insert_pipeline.py joins in PR 13: its stage queue IS the
 # pipeline depth bound — an unbounded queue there would let speculation
 # run arbitrarily far ahead of commit.
+# ethdb/ joins in PR 15 (storage fault armor): the degraded read-only
+# rung keeps reads serving while writes fail, so the storage boundary
+# is itself a serving path — a retry queue or helper pool growing
+# without bound under persistent disk failure would turn a survivable
+# fault into a memory-pressure collapse.
 SERVING_PATHS = (
     "coreth_tpu/rpc/",
     "coreth_tpu/vm/api.py",
@@ -758,6 +763,7 @@ SERVING_PATHS = (
     "coreth_tpu/peer/",
     "coreth_tpu/sync/",
     "coreth_tpu/core/insert_pipeline.py",
+    "coreth_tpu/ethdb/",
 )
 _QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue"}
 

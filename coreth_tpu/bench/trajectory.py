@@ -4,9 +4,10 @@ Ingests every per-round bench artifact in the repo root — `BENCH_rNN.json`
 (the config-1 device leg run through the axon tunnel), `BENCH_EARLY_rNN.json`
 (the pre-suite early capture), `BENCH_SUITE_rNN.json` (the bench-suite
 configs), `MULTICHIP_rNN.json` (the 8-device mesh dryrun, parsed from its
-"dryrun_multichip OK" tail lines) — normalizes each measured leg into a
-(config, metric, provenance) series across rounds, and writes
-`BENCH_TRAJECTORY.json` with median + MAD noise bands per series.
+"dryrun_multichip OK" tail lines), `CHAOS_rNN.json` (the chaos conductor's
+`--json` result: coverage + violation counts, never timings) — normalizes
+each measured leg into a (config, metric, provenance) series across rounds,
+and writes `BENCH_TRAJECTORY.json` with median + MAD noise bands per series.
 
 Provenance is the point: a nodes/s number from a live TPU and the same
 metric from the XLA-CPU stand-in (the standing axon-tunnel caveat) are NOT
@@ -126,6 +127,38 @@ def _multichip_points(data: dict, rnd: int,
     return points, []
 
 
+def _chaos_points(data: dict, rnd: int,
+                  source: str) -> Tuple[List[dict], List[dict]]:
+    """One CHAOS_rNN.json (the conductor's --json result) -> coverage
+    series. Counts, not rates — the conductor proves invariants hold
+    under injected faults, so the series track how much of the fault
+    matrix each round exercised (failpoints fired, subsystems touched,
+    blocks survived) plus the violation count itself; direction is
+    unjudgeable, the sentinel reports them without gating. A run that
+    recorded violations still ingests — a rising violations series in
+    the artifact history is exactly what the sentinel is for."""
+    config = f"chaos-seed{data.get('seed', '?')}"
+    cov = data.get("coverage") or {}
+    final = data.get("final") or {}
+    metrics = (
+        ("chaos_steps", data.get("steps")),
+        ("chaos_violations", len(data.get("violations") or [])),
+        ("chaos_failpoints_fired", cov.get("failpoints_fired")),
+        ("chaos_subsystems", len(cov.get("subsystems") or [])),
+        ("chaos_height", final.get("height")),
+    )
+    points: List[dict] = []
+    for metric, value in metrics:
+        if isinstance(value, (int, float)):
+            points.append({
+                "round": rnd, "source": source, "config": config,
+                "metric": metric, "value": float(value),
+                "unit": None, "vs_baseline": None,
+                "provenance": "xla-cpu-standin",
+            })
+    return points, []
+
+
 def _round_of(path: str) -> Optional[int]:
     m = _ROUND_RE.search(os.path.basename(path))
     return int(m.group(1)) if m else None
@@ -168,6 +201,7 @@ def load_artifacts(root: str) -> Tuple[List[dict], List[dict]]:
     paths += sorted(p for p in glob.glob(
         os.path.join(root, "MULTICHIP_*.json"))
         if not os.path.basename(p).startswith("MULTICHIP_PALLAS"))
+    paths += sorted(glob.glob(os.path.join(root, "CHAOS_*.json")))
     for path in paths:
         name = os.path.basename(path)
         if name == OUTPUT:
@@ -184,6 +218,10 @@ def load_artifacts(root: str) -> Tuple[List[dict], List[dict]]:
             continue
         if name.startswith("MULTICHIP_"):
             p, s = _multichip_points(data, rnd, name)
+            points += p
+            skipped += s
+        elif name.startswith("CHAOS_"):
+            p, s = _chaos_points(data, rnd, name)
             points += p
             skipped += s
         elif name.startswith("BENCH_SUITE_"):

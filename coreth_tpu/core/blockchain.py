@@ -37,6 +37,15 @@ class ChainError(Exception):
     pass
 
 
+class ChainDegradedError(ChainError):
+    """Inserts refused: the chain demoted itself to the degraded
+    read-only rung after a persistent storage write failure (the
+    bottom of the same ladder the device/mirror path rides —
+    ROBUSTNESS.md "Storage faults & degraded mode"). Reads, RPC, and
+    metrics keep serving; every insert attempt re-probes the disk and
+    the chain re-promotes itself once a probe write lands."""
+
+
 class TailStalled(ChainError):
     """A bounded join on the insert tail / acceptor queue expired: the
     async worker is wedged (or its current item is), and the caller
@@ -195,6 +204,18 @@ class CacheConfig:
     # 0 = the serial insert path (every stage under chainmu, the seed
     # behavior); validated range 0-3
     insert_pipeline_depth: int = 0
+    # --- storage fault armor (ROBUSTNESS.md "Storage faults") ---
+    # re-hash hash-addressed payloads as they leave disk: header RLP and
+    # contract code against their hash keys (rawdb), body/receipt
+    # content against the header's tx/receipt roots (chain layer). A
+    # mismatch counts db/verify_failures and raises typed
+    # CorruptDataError instead of feeding bad bytes into consensus
+    db_verify_on_read: bool = False
+    # transient storage-error (ethdb.DBError) retries for the insert
+    # tail's rawdb writes, paced by fault.Backoff, before the chain
+    # demotes itself to the degraded read-only rung; 0 = the first
+    # failure degrades. CorruptDataError is never retried
+    db_retry_budget: int = 2
 
 
 # counter/timer families snapshotted around each insert so the flight
@@ -302,6 +323,16 @@ class BlockChain:
         self.cache_config = cache_config
         self.config = config
         self.engine = engine
+        # storage fault armor: mount the process-wide rawdb verify mode
+        # from this chain's knob, and start healthy on the degraded
+        # ladder (persistent tail write failure demotes; a probe write
+        # on a later insert attempt re-promotes)
+        rawdb.set_verify_on_read(cache_config.db_verify_on_read)
+        self.degraded = False
+        self._degraded_mu = threading.Lock()
+        # tail items whose rawdb writes failed persistently: replayed
+        # in order when the chain re-promotes, so recovery loses nothing
+        self._degraded_pending: List[tuple] = []
         if state_database is None:
             from ..ops.device import get_batch_keccak
 
@@ -594,6 +625,18 @@ class BlockChain:
         version = int.from_bytes(items[2], "big") if isinstance(items[2], bytes) else items[2]
         ext = items[3] if len(items) > 3 and items[3] != b"" else None
         blk = Block(header, txs, uncles, version, ext)
+        if self.cache_config.db_verify_on_read:
+            # the body keys on the BLOCK hash, so its content check is
+            # against the header's tx root (rawdb already re-hashed the
+            # header RLP against the block hash on the way out)
+            if derive_sha(txs) != header.tx_hash:
+                from ..ethdb import CorruptDataError
+                from ..metrics import default_registry as _metrics
+
+                _metrics.counter("db/verify_failures").inc()
+                raise CorruptDataError(
+                    f"body payload failed verify-on-read: tx root "
+                    f"mismatch for block {block_hash.hex()}")
         self._blocks[block_hash] = blk
         return blk
 
@@ -641,6 +684,22 @@ class BlockChain:
             return None
         items = rlp.decode(blob)
         receipts = [Receipt.decode(r) for r in items]
+        if self.cache_config.db_verify_on_read:
+            cached = self._blocks.get(block_hash)
+            if cached is not None:
+                hdr = cached.header
+            else:  # by hash, not number: the block may be non-canonical
+                hdr_blob = rawdb.read_header_rlp(
+                    self.diskdb, number, block_hash)
+                hdr = Header.decode(hdr_blob) if hdr_blob else None
+            if hdr is not None and derive_sha(receipts) != hdr.receipt_hash:
+                from ..ethdb import CorruptDataError
+                from ..metrics import default_registry as _metrics
+
+                _metrics.counter("db/verify_failures").inc()
+                raise CorruptDataError(
+                    f"receipts payload failed verify-on-read: receipt "
+                    f"root mismatch for block {block_hash.hex()}")
         # stored receipts hold only consensus fields; rederive the rest
         # (types.deriveReceiptFields — tx hash, gas used, contract addr…)
         block = self.get_block(block_hash)
@@ -869,6 +928,8 @@ class BlockChain:
         next submit or drain point (accept/reject/set_preference/
         insert_block_manual/stop) — same deferred-error contract as the
         async insert tail."""
+        if self.degraded:
+            self._probe_degraded()  # raises ChainDegradedError while sick
         if self.pipeline is not None:
             self.pipeline.submit(block)
             return
@@ -876,6 +937,8 @@ class BlockChain:
             self._insert_checked(block, writes=True)
 
     def insert_block_manual(self, block: Block, writes: bool) -> None:
+        if self.degraded:
+            self._probe_degraded()  # raises ChainDegradedError while sick
         # a writes=False semantic check runs against the latest committed
         # state; in-flight pipelined successors would race it — land them
         # (and surface any deferred commit error) first
@@ -1182,7 +1245,110 @@ class BlockChain:
             self.diskdb, n, h, rlp.encode([r.encode() for r in receipts])
         )
 
+    def _tail_write_retry(self, write_fn) -> None:
+        """Run one tail write with up to db_retry_budget Backoff-paced
+        retries for transient storage errors (typed ethdb.DBError from
+        any backend). CorruptDataError and non-storage exceptions
+        (failpoint-simulated crashes, bugs) propagate on first throw —
+        only I/O flakes are transient. Writes are idempotent puts, so a
+        replay from the top is safe."""
+        from ..ethdb import CorruptDataError, DBError
+        from ..fault import Backoff
+        from ..metrics import default_registry as _metrics
+
+        budget = max(0, self.cache_config.db_retry_budget)
+        backoff = Backoff(base=0.01, cap=0.5)
+        attempt = 0
+        while True:
+            try:
+                write_fn()
+                if attempt:
+                    _metrics.counter("db/retry_successes").inc()
+                return
+            except CorruptDataError:
+                raise
+            except DBError:
+                if attempt >= budget:
+                    raise
+                attempt += 1
+                _metrics.counter("db/retries").inc()
+                backoff.sleep()
+
+    def _enter_degraded(self, why: str, pending_item: tuple) -> None:
+        """Demote the chain to the degraded read-only rung: persistent
+        storage write failure stops inserts (typed ChainDegradedError at
+        the front door) instead of crashing the node, while reads, RPC,
+        and metrics keep serving. The failed tail item is stashed for
+        an in-order replay at re-promotion, so recovery loses nothing.
+        Same ladder shape as the device demote/probe/promote cycle."""
+        from ..log import get_logger, warn
+        from ..metrics import default_registry as _metrics
+
+        with self._degraded_mu:
+            self._degraded_pending.append(pending_item)
+            first = not self.degraded
+            self.degraded = True
+        if not first:
+            return
+        _metrics.gauge("chain/degraded").update(1)
+        _metrics.counter("chain/degraded_entries").inc()
+        self.flight_recorder.note_event("chain/degraded", why=why)
+        warn(get_logger("chain"),
+             "persistent storage write failure — chain demoted to "
+             "degraded read-only mode: inserts refused with "
+             "ChainDegradedError, reads/RPC keep serving; the next "
+             "insert attempt probes the disk for re-promotion",
+             why=why)
+
+    def _probe_degraded(self) -> None:
+        """One probe write against the disk from an insert attempt while
+        degraded. Failure keeps the rung (typed refusal); success
+        re-promotes: pending tail items replay in order, then inserts
+        flow again."""
+        from ..ethdb import DBError
+        from ..metrics import default_registry as _metrics
+
+        try:
+            self.diskdb.put(b"DegradedProbe", self.current_block.hash())
+        except DBError as e:
+            _metrics.counter("chain/degraded_probe_failures").inc()
+            raise ChainDegradedError(
+                f"chain is degraded read-only (storage writes failing); "
+                f"probe write failed: {e}") from e
+        # the disk accepts writes again: settle the tail, replay what
+        # the degraded window stashed, and re-promote
+        self._join_queue(self._tail_queue, "insert tail",
+                         self.cache_config.tail_join_timeout)
+        with self._degraded_mu:
+            pending, self._degraded_pending = self._degraded_pending, []
+        try:
+            for item in pending:
+                if item[0] == "head":
+                    rawdb.write_canonical_hash(
+                        self.diskdb, item[1].hash(), item[1].number)
+                    rawdb.write_head_block_hash(self.diskdb, item[1].hash())
+                else:
+                    self._write_block_data(item[1], item[2])
+        except DBError as e:
+            # the disk flaked again mid-replay: stay degraded with the
+            # unreplayed suffix intact
+            idx = pending.index(item)
+            with self._degraded_mu:
+                self._degraded_pending = (pending[idx:]
+                                          + self._degraded_pending)
+            _metrics.counter("chain/degraded_probe_failures").inc()
+            raise ChainDegradedError(
+                f"chain is degraded read-only; replay failed: {e}") from e
+        with self._degraded_mu:
+            self.degraded = False
+        self.tail_error = None  # surfaced through the rung, not join_tail
+        _metrics.gauge("chain/degraded").update(0)
+        _metrics.counter("chain/degraded_recoveries").inc()
+        self.flight_recorder.note_event(
+            "chain/degraded_recovered", replayed=len(pending))
+
     def _tail_worker(self) -> None:
+        from ..ethdb import DBError
         from ..metrics import default_registry as _metrics
 
         write_timer = _metrics.timer("chain/phase/write")
@@ -1198,11 +1364,18 @@ class BlockChain:
                 # before the data it points at — crash consistency by
                 # ordering, not fsync
                 _, block = item
-                try:
+
+                def _write_head(block=block):
                     failpoint("chain/tail/before_head")
                     rawdb.write_canonical_hash(
                         self.diskdb, block.hash(), block.number)
                     rawdb.write_head_block_hash(self.diskdb, block.hash())
+
+                try:
+                    self._tail_write_retry(_write_head)
+                except DBError as e:
+                    self._enter_degraded(
+                        f"head write failed after retries: {e}", item)
                 except Exception:
                     import traceback
 
@@ -1220,11 +1393,15 @@ class BlockChain:
                         # layer attached: the next block's state_at can open
                         # against it while we grind through the RLP encodes
                         snap_applied.set()
-                        self._write_block_data(block, receipts)
+                        self._tail_write_retry(
+                            lambda: self._write_block_data(block, receipts))
                 if rec is not None:
                     # late stamp into the shared record dict: readers of
                     # the flight ring see `write` once the tail lands
                     rec["phases"]["write"] = time.monotonic() - t0
+            except DBError as e:
+                self._enter_degraded(
+                    f"block data write failed after retries: {e}", item)
             except Exception:
                 import traceback
 

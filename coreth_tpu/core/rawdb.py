@@ -12,7 +12,37 @@ from __future__ import annotations
 
 from typing import Optional
 
-from ..ethdb import KeyValueStore
+from ..ethdb import CorruptDataError, KeyValueStore
+from ..metrics import default_registry
+
+# --- verify-on-read (db-verify-on-read) -------------------------------------
+# When on, hash-addressed payloads are re-hashed as they leave the disk:
+# header RLP against the block hash embedded in its key, contract code
+# against its code hash. A mismatch is counted (db/verify_failures) and
+# raised as typed CorruptDataError instead of feeding bad bytes into
+# consensus. Body/receipt payloads key on the BLOCK hash, so their
+# content checks (tx root / receipt root vs the header) live at the
+# chain layer behind the same knob.
+verify_on_read = False
+
+
+def set_verify_on_read(on: bool) -> None:
+    """Flip the process-wide verify mode (mounted from
+    CacheConfig.db_verify_on_read at chain boot)."""
+    global verify_on_read
+    verify_on_read = bool(on)
+
+
+def _verify(blob: bytes, want_hash: bytes, what: str) -> bytes:
+    from ..native import keccak256
+
+    if keccak256(blob) != want_hash:
+        default_registry.counter("db/verify_failures").inc()
+        raise CorruptDataError(
+            f"{what} payload failed verify-on-read: keccak mismatch for "
+            f"hash {want_hash.hex()}")
+    default_registry.counter("db/verified_reads").inc()
+    return blob
 
 # --- prefixes (core/rawdb/schema.go) ---------------------------------------
 HEADER_PREFIX = b"h"          # h + num(8) + hash -> header RLP
@@ -50,9 +80,11 @@ def code_key(code_hash: bytes) -> bytes:
 
 def read_code(db: KeyValueStore, code_hash: bytes) -> Optional[bytes]:
     code = db.get(code_key(code_hash))
-    if code is not None:
-        return code
-    return db.get(code_hash)  # legacy un-prefixed fallback, like the reference
+    if code is None:
+        code = db.get(code_hash)  # legacy un-prefixed fallback, like the reference
+    if code is not None and verify_on_read:
+        _verify(code, code_hash, "code")
+    return code
 
 
 def write_code(db, code_hash: bytes, code: bytes) -> None:
@@ -105,7 +137,10 @@ def receipts_key(number: int, block_hash: bytes) -> bytes:
 
 
 def read_header_rlp(db, number: int, block_hash: bytes) -> Optional[bytes]:
-    return db.get(header_key(number, block_hash))
+    blob = db.get(header_key(number, block_hash))
+    if blob is not None and verify_on_read:
+        _verify(blob, block_hash, "header")
+    return blob
 
 
 def write_header_rlp(db, number: int, block_hash: bytes, blob: bytes) -> None:

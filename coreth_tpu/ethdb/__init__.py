@@ -12,6 +12,19 @@ import threading
 from typing import Dict, Iterator, List, Optional, Tuple
 
 
+class DBError(RuntimeError):
+    """Typed storage-layer failure (role of the reference backends'
+    wrapped pebble/leveldb errors). RuntimeError subclass so callers
+    that predate the type keep working; new code catches DBError."""
+
+
+class CorruptDataError(DBError):
+    """A value came back from disk but failed its integrity check
+    (hash-key mismatch under db-verify-on-read, or an injected
+    ethdb/corrupt_read bit flip caught downstream). Never retried —
+    corruption is not transient."""
+
+
 class KeyValueStore:
     def get(self, key: bytes) -> Optional[bytes]:
         raise NotImplementedError
@@ -118,3 +131,9 @@ class MemoryDB(KeyValueStore):
     def __len__(self):
         with self._lock:
             return len(self._data)
+
+
+# Registers the ethdb/* failpoint siblings at package import so the
+# SA006 catalogue always carries them (faultdb imports KeyValueStore
+# from here, hence the tail position).
+from .faultdb import FaultInjectingDB  # noqa: E402

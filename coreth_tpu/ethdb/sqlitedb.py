@@ -18,6 +18,10 @@ Contract details matched to the reference backends:
     prefix+start, bounded to the prefix
   - close() is idempotent; operations after close raise (database.go
     ErrClosed semantics)
+  - sqlite3.Error never escapes raw: every operation surfaces typed
+    ethdb.DBError (counted under drop/ethdb/sqlite/<op>) so the armor
+    above — Backoff retries, the chain's degraded rung — catches one
+    exception type for every backend
 """
 
 from __future__ import annotations
@@ -27,7 +31,8 @@ import sqlite3
 import threading
 from typing import Iterator, List, Optional, Tuple
 
-from . import KeyValueStore
+from ..metrics import count_drop
+from . import DBError, KeyValueStore
 
 _ITER_CHUNK = 1024
 
@@ -41,59 +46,81 @@ class SQLiteDB(KeyValueStore):
         self.path = path
         self._lock = threading.RLock()
         self._closed = False
-        self._conn = sqlite3.connect(path, check_same_thread=False)
-        cur = self._conn.cursor()
-        cur.execute("PRAGMA journal_mode=WAL")
-        cur.execute(f"PRAGMA synchronous={'NORMAL' if sync else 'OFF'}")
-        cur.execute(f"PRAGMA cache_size={-1024 * cache_mb}")
-        cur.execute(
-            "CREATE TABLE IF NOT EXISTS kv ("
-            "k BLOB PRIMARY KEY, v BLOB NOT NULL) WITHOUT ROWID"
-        )
-        self._conn.commit()
+        try:
+            self._conn = sqlite3.connect(path, check_same_thread=False)
+            cur = self._conn.cursor()
+            cur.execute("PRAGMA journal_mode=WAL")
+            cur.execute(f"PRAGMA synchronous={'NORMAL' if sync else 'OFF'}")
+            cur.execute(f"PRAGMA cache_size={-1024 * cache_mb}")
+            cur.execute(
+                "CREATE TABLE IF NOT EXISTS kv ("
+                "k BLOB PRIMARY KEY, v BLOB NOT NULL) WITHOUT ROWID"
+            )
+            self._conn.commit()
+        except sqlite3.Error as e:
+            count_drop("ethdb/sqlite/open")
+            raise DBError(f"sqlitedb: open {path!r} failed: {e}") from e
 
     # -- helpers -----------------------------------------------------------
 
     def _check_open(self):
         if self._closed:
-            raise RuntimeError("sqlitedb: database closed")
+            raise DBError("sqlitedb: database closed")
 
     # -- KeyValueStore -----------------------------------------------------
 
     def get(self, key: bytes) -> Optional[bytes]:
         with self._lock:
             self._check_open()
-            row = self._conn.execute(
-                "SELECT v FROM kv WHERE k = ?", (bytes(key),)
-            ).fetchone()
+            try:
+                row = self._conn.execute(
+                    "SELECT v FROM kv WHERE k = ?", (bytes(key),)
+                ).fetchone()
+            except sqlite3.Error as e:
+                count_drop("ethdb/sqlite/get")
+                raise DBError(f"sqlitedb: get failed: {e}") from e
         return bytes(row[0]) if row is not None else None
 
     def put(self, key: bytes, value: bytes) -> None:
         with self._lock:
             self._check_open()
-            self._conn.execute(
-                "INSERT INTO kv(k, v) VALUES(?, ?) "
-                "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
-                (bytes(key), bytes(value)),
-            )
-            self._conn.commit()
+            try:
+                self._conn.execute(
+                    "INSERT INTO kv(k, v) VALUES(?, ?) "
+                    "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+                    (bytes(key), bytes(value)),
+                )
+                self._conn.commit()
+            except sqlite3.Error as e:
+                count_drop("ethdb/sqlite/put")
+                raise DBError(f"sqlitedb: put failed: {e}") from e
 
     def delete(self, key: bytes) -> None:
         with self._lock:
             self._check_open()
-            self._conn.execute("DELETE FROM kv WHERE k = ?", (bytes(key),))
-            self._conn.commit()
+            try:
+                self._conn.execute(
+                    "DELETE FROM kv WHERE k = ?", (bytes(key),))
+                self._conn.commit()
+            except sqlite3.Error as e:
+                count_drop("ethdb/sqlite/delete")
+                raise DBError(f"sqlitedb: delete failed: {e}") from e
 
     def has(self, key: bytes) -> bool:
         with self._lock:
             self._check_open()
-            row = self._conn.execute(
-                "SELECT 1 FROM kv WHERE k = ?", (bytes(key),)
-            ).fetchone()
+            try:
+                row = self._conn.execute(
+                    "SELECT 1 FROM kv WHERE k = ?", (bytes(key),)
+                ).fetchone()
+            except sqlite3.Error as e:
+                count_drop("ethdb/sqlite/get")
+                raise DBError(f"sqlitedb: has failed: {e}") from e
         return row is not None
 
     def write_batch(self, writes: List[Tuple[bytes, Optional[bytes]]]) -> None:
-        """One transaction: crash-atomic across the whole batch."""
+        """One transaction: crash-atomic across the whole batch
+        (a torn batch is all-or-nothing at this layer)."""
         with self._lock:
             self._check_open()
             cur = self._conn.cursor()
@@ -109,8 +136,14 @@ class SQLiteDB(KeyValueStore):
                             (bytes(k), bytes(v)),
                         )
                 self._conn.commit()
-            except BaseException:
+            except BaseException as e:
+                # Roll back so the failed batch leaves NO partial bytes;
+                # sqlite errors leave as typed DBError, everything else
+                # (failpoints, KeyboardInterrupt) re-raises as-is.
                 self._conn.rollback()
+                if isinstance(e, sqlite3.Error):
+                    count_drop("ethdb/sqlite/batch")
+                    raise DBError(f"sqlitedb: batch failed: {e}") from e
                 raise
 
     def iterate(
@@ -124,16 +157,22 @@ class SQLiteDB(KeyValueStore):
         while True:
             with self._lock:
                 self._check_open()  # close() mid-scan must fail loudly
-                if first:
-                    rows = self._conn.execute(
-                        "SELECT k, v FROM kv WHERE k >= ? ORDER BY k LIMIT ?",
-                        (lo, _ITER_CHUNK),
-                    ).fetchall()
-                else:
-                    rows = self._conn.execute(
-                        "SELECT k, v FROM kv WHERE k > ? ORDER BY k LIMIT ?",
-                        (lo, _ITER_CHUNK),
-                    ).fetchall()
+                try:
+                    if first:
+                        rows = self._conn.execute(
+                            "SELECT k, v FROM kv WHERE k >= ? "
+                            "ORDER BY k LIMIT ?",
+                            (lo, _ITER_CHUNK),
+                        ).fetchall()
+                    else:
+                        rows = self._conn.execute(
+                            "SELECT k, v FROM kv WHERE k > ? "
+                            "ORDER BY k LIMIT ?",
+                            (lo, _ITER_CHUNK),
+                        ).fetchall()
+                except sqlite3.Error as e:
+                    count_drop("ethdb/sqlite/iterate")
+                    raise DBError(f"sqlitedb: iterate failed: {e}") from e
             for k, v in rows:
                 k = bytes(k)
                 if prefix and not k.startswith(prefix):
@@ -147,14 +186,25 @@ class SQLiteDB(KeyValueStore):
     def compact(self) -> None:
         with self._lock:
             self._check_open()
-            self._conn.execute("VACUUM")
+            try:
+                self._conn.execute("VACUUM")
+            except sqlite3.Error as e:
+                count_drop("ethdb/sqlite/compact")
+                raise DBError(f"sqlitedb: compact failed: {e}") from e
 
     def stat(self) -> dict:
         with self._lock:
             self._check_open()
-            n = self._conn.execute("SELECT COUNT(*) FROM kv").fetchone()[0]
-            pages = self._conn.execute("PRAGMA page_count").fetchone()[0]
-            page_size = self._conn.execute("PRAGMA page_size").fetchone()[0]
+            try:
+                n = self._conn.execute(
+                    "SELECT COUNT(*) FROM kv").fetchone()[0]
+                pages = self._conn.execute(
+                    "PRAGMA page_count").fetchone()[0]
+                page_size = self._conn.execute(
+                    "PRAGMA page_size").fetchone()[0]
+            except sqlite3.Error as e:
+                count_drop("ethdb/sqlite/stat")
+                raise DBError(f"sqlitedb: stat failed: {e}") from e
         return {"entries": n, "bytes": pages * page_size}
 
     def close(self) -> None:
@@ -162,8 +212,14 @@ class SQLiteDB(KeyValueStore):
             if self._closed:
                 return
             self._closed = True
-            self._conn.commit()
-            self._conn.close()
+            try:
+                self._conn.commit()
+                self._conn.close()
+            except sqlite3.Error as e:
+                # The handle is gone either way; closed-state is set, so
+                # count it and surface the typed failure.
+                count_drop("ethdb/sqlite/close")
+                raise DBError(f"sqlitedb: close failed: {e}") from e
 
     def __len__(self):
         return self.stat()["entries"]

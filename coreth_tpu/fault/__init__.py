@@ -173,6 +173,24 @@ def set_seed(seed: int) -> None:
     _seed = seed
 
 
+def seed() -> int:
+    """The active deterministic seed (env or set_seed). Sites that need
+    their own seeded randomness (ethdb/corrupt_read's bit pick) derive
+    from this so chaos runs replay bit-exactly."""
+    return _seed
+
+
+def is_armed(name: str) -> bool:
+    """True iff [name] is currently armed. For sites whose *shape*
+    changes when armed (FaultInjectingDB splits a batch in two only
+    while ethdb/torn_batch is armed) — never needed on the fast path,
+    which stays on the bare `enabled` bool."""
+    if not enabled:
+        return False
+    with _lock:
+        return name in _armed
+
+
 def failpoint(name: str) -> None:
     """The injection site. A single module-bool check when nothing is
     armed; otherwise fires the configured action for [name]."""

@@ -498,6 +498,11 @@ def health_check(vm) -> dict:
         "lastAcceptedHeight": vm.blockchain.last_accepted.number,
         "error": vm.blockchain.acceptor_error,
     }
+    if getattr(vm.blockchain, "degraded", False):
+        # degraded read-only rung (storage write failure): the node
+        # still serves reads so it stays in the LB pool, but operators
+        # see the rung on every health poll
+        out["degraded"] = True
     server = getattr(vm, "rpc_server", None)
     if server is not None and getattr(server, "draining", False):
         out["healthy"] = False

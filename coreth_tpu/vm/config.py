@@ -162,6 +162,15 @@ class Config:
     # deadline (s) for insert-tail / acceptor-queue joins; on expiry the
     # join raises a diagnosable TailStalled instead of hanging. 0 off
     tail_join_timeout: float = 0.0
+    # re-hash hash-addressed payloads (headers/code, body/receipt
+    # content) as they leave disk: a mismatch raises typed
+    # CorruptDataError + counts db/verify_failures instead of feeding
+    # bad bytes into consensus ("db-verify-on-read")
+    db_verify_on_read: bool = False
+    # transient storage-error retries (fault.Backoff-paced) for insert
+    # tail writes before the chain demotes itself to the degraded
+    # read-only rung; 0 = first failure degrades ("db-retry-budget")
+    db_retry_budget: int = 2
     # commitment backend (COMMITMENT.md): "mpt" (consensus default) or
     # "bintrie-shadow" (mount the experimental binary-Merkle backend
     # beside the MPT; divergences quarantine, consensus is unaffected)
@@ -335,6 +344,10 @@ class Config:
             raise ValueError(
                 f"tail-join-timeout must be >= 0 "
                 f"(got {self.tail_join_timeout})")
+        if self.db_retry_budget < 0:
+            raise ValueError(
+                f"db-retry-budget must be >= 0 "
+                f"(got {self.db_retry_budget})")
         if self.state_backend not in ("mpt", "bintrie-shadow"):
             raise ValueError(
                 f"state-backend must be 'mpt' or 'bintrie-shadow' "

@@ -229,6 +229,8 @@ class VM:
                     full.resident_template_residency),
                 resident_mesh_devices=full.resident_mesh_devices,
                 tail_join_timeout=full.tail_join_timeout,
+                db_verify_on_read=full.db_verify_on_read,
+                db_retry_budget=full.db_retry_budget,
                 state_backend=full.state_backend,
                 shadow_check_interval=full.shadow_check_interval,
                 evm_parallel_workers=full.evm_parallel_workers,

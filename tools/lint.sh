@@ -20,6 +20,17 @@ echo "== coreth_tpu.bench.trajectory --check (bench regression sentinel) =="
 python -m coreth_tpu.bench.trajectory --check || rc=1
 
 echo
+echo "== coreth_tpu.fault.chaos (deterministic chaos smoke, seed 1) =="
+# skips cleanly (exit 0) when jax is unavailable in the lint image;
+# any invariant violation in the 50-step conductor run fails the lint
+if python -c "import jax" >/dev/null 2>&1; then
+    JAX_PLATFORMS=cpu python -m coreth_tpu.fault.chaos --steps 50 --seed 1 \
+        || rc=1
+else
+    echo "chaos smoke: jax not installed; skipping"
+fi
+
+echo
 if python -c "import mypy" >/dev/null 2>&1; then
     echo "== mypy (strict core subset, mypy.ini) =="
     python -m mypy --config-file mypy.ini || rc=1
