@@ -1,0 +1,455 @@
+"""Open-loop read-traffic storm (BENCH_STORM / config 18, PR 16).
+
+A/B bench for the lock-free read serving tier: thousands of logical
+clients fire a Poisson arrival stream of mixed read RPCs (getBalance,
+getTransactionCount, call, getStorageAt, getLogs, gasPrice,
+blockNumber) at an RPCServer while a writer thread keeps the pipelined
+insert path (insert_pipeline_depth=2) busy on the same chain. Two legs:
+
+  locked  the pre-PR contention model — every read resolves its head
+          and state under chainmu, queueing behind the insert load
+          (LockedBackend below; lives in benches/ precisely because
+          SA010 bans this shape from the real read tier)
+  view    the shipped path — reads resolve against the atomically
+          published ReadView and never touch chainmu
+
+The storm is OPEN-LOOP: arrivals are a precomputed seeded Poisson
+schedule and latency is measured from the SCHEDULED arrival time, so
+when the server falls behind, queueing delay lands in the percentiles
+instead of silently throttling the offered rate (closed-loop benches
+can't see saturation). Each leg sweeps an offered-rate ladder; the
+saturation throughput is the highest GOODPUT (result-bearing answers
+per second) over the sweep, and a ladder rung whose goodput drops below
+0.9x offered ends the sweep. The server runs the full PR-7 overload
+stack (bounded lanes, -32005 shedding, deadlines, circuit breaker), so
+sheds and in-band errors are counted, not crashed on.
+
+    python benches/bench_storm.py                 # full ladder, ~30s
+    python benches/bench_storm.py --smoke         # ~2s lint-stage smoke
+    python benches/bench_storm.py --round 13      # BENCH_STORM_r13.json
+
+Artifact (BENCH_STORM_rNN.json): per-leg per-method p50/p90/p99 ms +
+saturation_per_sec, host_mode: true (this is a host-concurrency bench —
+no device code runs; the trajectory sentinel tags it accordingly).
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_LOGICAL_CLIENTS = 2000   # schedule entries are multiplexed client slots
+WORKERS = 16               # OS threads draining the schedule
+SAT_FRACTION = 0.9         # goodput below this x offered = saturated
+WRITER_TXS_PER_BLOCK = 32  # block size of the pregenerated insert corpus
+
+# (method, weight, params builder) — params close over the funded world
+METHOD_MIX = (
+    ("eth_getBalance", 0.28),
+    ("eth_getTransactionCount", 0.15),
+    ("eth_call", 0.12),
+    ("eth_getStorageAt", 0.10),
+    ("eth_getLogs", 0.08),
+    ("eth_gasPrice", 0.15),
+    ("eth_blockNumber", 0.12),
+)
+
+
+def _pctl(sorted_xs, q):
+    if not sorted_xs:
+        return 0.0
+    return sorted_xs[min(len(sorted_xs) - 1, int(len(sorted_xs) * q))]
+
+
+# ------------------------------------------------------------- the world
+
+
+KEY = b"\x55" * 32
+DEST = b"\xdd" * 20
+
+
+def _fresh_chain():
+    """Every leg (and the block factory) boots an identical world:
+    commit-every-block pruning chain with the staged insert pipeline —
+    the commit/write stage is the chainmu-held work the locked leg's
+    reads must queue behind."""
+    from coreth_tpu import params
+    from coreth_tpu.consensus.dummy import new_dummy_engine
+    from coreth_tpu.core.blockchain import BlockChain, CacheConfig
+    from coreth_tpu.core.genesis import Genesis, GenesisAccount
+    from coreth_tpu.crypto.secp256k1 import priv_to_address
+    from coreth_tpu.ethdb import MemoryDB
+    from coreth_tpu.state.database import Database
+    from coreth_tpu.trie.triedb import TrieDatabase
+
+    addr = priv_to_address(KEY)
+    diskdb = MemoryDB()
+    genesis = Genesis(
+        config=params.TEST_CHAIN_CONFIG, gas_limit=params.CORTINA_GAS_LIMIT,
+        alloc={addr: GenesisAccount(balance=10**24)},
+    )
+    chain = BlockChain(
+        diskdb,
+        CacheConfig(pruning=True, commit_interval=1, insert_pipeline_depth=2),
+        params.TEST_CHAIN_CONFIG, genesis, new_dummy_engine(),
+        state_database=Database(TrieDatabase(diskdb)),
+    )
+    return chain, addr
+
+
+def build_corpus(n_blocks: int):
+    """Pregenerate the writer's insert corpus ONCE against a throwaway
+    chain with the same genesis — both legs then insert the identical
+    immutable block objects, so the write load is deterministic and the
+    generation cost (tx execution) stays outside the measured window."""
+    from coreth_tpu.core.chain_makers import generate_chain
+    from coreth_tpu.core.types import Signer, Transaction
+
+    chain, addr = _fresh_chain()
+    signer = Signer(43112)
+    per = WRITER_TXS_PER_BLOCK
+
+    def gen(i, bg):
+        for j in range(per):
+            t = Transaction(type=2, chain_id=43112, nonce=i * per + j,
+                            max_fee=10**12, max_priority_fee=10**9,
+                            gas=21000, to=DEST, value=3)
+            bg.add_tx(signer.sign(t, KEY))
+
+    blocks, _ = generate_chain(
+        chain.config, chain.current_block, chain.engine,
+        chain.state_database, n_blocks, gen=gen)
+    chain.stop()
+    return blocks
+
+
+def build_world(locked: bool):
+    """A funded chain + txpool + RPC server with the full PR-7
+    overload stack (bounded lanes, shedding, deadlines, breaker)."""
+    from coreth_tpu.core.txpool import TxPool, TxPoolConfig
+    from coreth_tpu.eth.api import EthAPI
+    from coreth_tpu.eth.backend import EthBackend
+    from coreth_tpu.rpc.admission import ServingPolicy
+    from coreth_tpu.rpc.server import RPCServer
+
+    chain, addr = _fresh_chain()
+    pool = TxPool(TxPoolConfig(), chain.config, chain)
+    backend_cls = LockedBackend if locked else EthBackend
+    backend = backend_cls(chain, pool)
+    server = RPCServer(ServingPolicy(
+        max_workers=WORKERS, queue_size=4 * N_LOGICAL_CLIENTS,
+        expensive_workers=8, expensive_queue_size=N_LOGICAL_CLIENTS,
+        cheap_budget=5.0, expensive_budget=10.0))
+    server.register_api("eth", EthAPI(backend))
+    return chain, server, addr, DEST
+
+
+def _make_locked_backend():
+    """Defined lazily so importing this module never imports the chain
+    stack (the suite imports bench modules to read docstrings)."""
+    from coreth_tpu.eth.api import parse_hex
+    from coreth_tpu.eth.backend import EthBackend
+    from coreth_tpu.rpc.server import RPCError
+
+    class LockedBackend(EthBackend):
+        """The pre-PR read path: head + state resolution under chainmu.
+        This class is the A/B foil and MUST stay in benches/ — SA010
+        flags exactly this shape inside coreth_tpu/eth/."""
+
+        def last_accepted_block(self):
+            with self.chain.chainmu:
+                return self.chain.last_accepted_block()
+
+        def current_block(self):
+            with self.chain.chainmu:
+                return self.chain.current_block
+
+        def block_by_tag(self, tag):
+            with self.chain.chainmu:
+                return self._locked_block_by_tag(tag)
+
+        def _locked_block_by_tag(self, tag):
+            if tag in ("latest", "accepted"):
+                return self.chain.last_accepted_block()
+            if tag == "pending":
+                return self.chain.current_block
+            if tag == "earliest":
+                return self.chain.genesis_block
+            number = parse_hex(tag)
+            head = self.chain.last_accepted_block().number
+            if number > head and not self.allow_unfinalized_queries:
+                raise RPCError(-32000, "cannot query unfinalized data")
+            return self.chain.get_block_by_number(number)
+
+        def _block_in_view(self, view, tag):
+            return self.block_by_tag(tag)
+
+        def state_at_tag(self, tag):
+            with self.chain.chainmu:
+                blk = self._locked_block_by_tag(tag)
+                if blk is None:
+                    raise RPCError(-32000, "block not found")
+                return self.chain.state_at(blk.root)
+
+        def state_at_root(self, root):
+            with self.chain.chainmu:
+                return self.chain.state_at(root)
+
+        def do_call(self, call_obj, tag, wrap_state=None):
+            with self.chain.chainmu:
+                return super().do_call(call_obj, tag, wrap_state)
+
+    return LockedBackend
+
+
+LockedBackend = None  # bound in main() before build_world(locked=True)
+
+
+class InsertLoad(threading.Thread):
+    """Writer leg: drains the pregenerated corpus through the pipelined
+    insert/accept path flat-out for the whole sweep, so the locked
+    leg's reads have real chainmu contention (execute of k+1 overlapped
+    with the chainmu-held commit/write of k) to queue behind."""
+
+    def __init__(self, chain, corpus):
+        super().__init__(daemon=True)
+        self.chain, self.corpus = chain, corpus
+        self.stop_flag = threading.Event()
+        self.blocks = 0
+        self.exhausted = False
+
+    def run(self):
+        chain = self.chain
+        for b in self.corpus:
+            if self.stop_flag.is_set():
+                break
+            chain.insert_block(b)
+            chain.accept(b)
+            self.blocks += 1
+        else:
+            self.exhausted = True  # sweep outlived the corpus: log it
+        chain.drain_acceptor_queue()
+
+
+# ------------------------------------------------------------- the storm
+
+
+def _build_request(method, addr_hex, dest_hex, client_id):
+    if method == "eth_getBalance":
+        prm = [dest_hex, "latest"]
+    elif method == "eth_getTransactionCount":
+        prm = [addr_hex, "latest"]
+    elif method == "eth_call":
+        prm = [{"from": addr_hex, "to": dest_hex, "value": "0x1"}, "latest"]
+    elif method == "eth_getStorageAt":
+        prm = [dest_hex, "0x0", "latest"]
+    elif method == "eth_getLogs":
+        prm = [{"fromBlock": "latest", "toBlock": "latest"}]
+    else:  # eth_gasPrice / eth_blockNumber
+        prm = []
+    return json.dumps({"jsonrpc": "2.0", "id": client_id, "method": method,
+                       "params": prm}).encode()
+
+
+def build_schedule(rate, duration, seed, addr_hex, dest_hex):
+    """Precomputed open-loop arrival schedule: (t_offset, method, raw)
+    tuples. Client ids cycle over the logical-client population."""
+    rng = random.Random(seed)
+    methods = [m for m, _ in METHOD_MIX]
+    weights = [w for _, w in METHOD_MIX]
+    sched = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(rate)
+        if t >= duration:
+            break
+        m = rng.choices(methods, weights)[0]
+        sched.append((t, m, _build_request(
+            m, addr_hex, dest_hex, len(sched) % N_LOGICAL_CLIENTS)))
+    return sched
+
+
+def run_leg(server, sched, duration):
+    """Drain one ladder rung; returns achieved goodput + per-method
+    latencies (measured from scheduled arrival — queueing included)."""
+    counter = itertools.count()
+    locals_ = [([], [0, 0]) for _ in range(WORKERS)]  # (lats, [good, shed])
+    start = time.monotonic() + 0.05
+
+    def worker(slot):
+        lats, counts = locals_[slot]
+        while True:
+            i = next(counter)
+            if i >= len(sched):
+                return
+            t_off, method, raw = sched[i]
+            delay = start + t_off - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            resp = server.handle_raw(raw)
+            lat = time.monotonic() - (start + t_off)
+            lats.append((method, lat))
+            if b'"error"' in resp:
+                counts[1] += 1
+            else:
+                counts[0] += 1
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in range(WORKERS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = max(time.monotonic() - start, duration)
+    good = sum(c[0] for _, c in locals_)
+    shed = sum(c[1] for _, c in locals_)
+    by_method = {}
+    for lats, _ in locals_:
+        for method, lat in lats:
+            by_method.setdefault(method, []).append(lat * 1000.0)
+    return {"goodput_per_sec": good / wall, "good": good, "shed": shed,
+            "wall_s": wall, "by_method": by_method}
+
+
+def sweep(server, rates, duration, seed, addr_hex, dest_hex):
+    """Climb the offered-rate ladder until goodput collapses below
+    SAT_FRACTION x offered; saturation = best goodput seen."""
+    legs = []
+    for rate in rates:
+        sched = build_schedule(rate, duration, seed + int(rate), addr_hex,
+                               dest_hex)
+        leg = run_leg(server, sched, duration)
+        leg["offered_per_sec"] = rate
+        legs.append(leg)
+        print(f"  offered {rate:6.0f}/s -> goodput "
+              f"{leg['goodput_per_sec']:7.1f}/s ({leg['shed']} errors/sheds)",
+              flush=True)
+        if leg["goodput_per_sec"] < SAT_FRACTION * rate:
+            break
+    best = max(legs, key=lambda leg: leg["goodput_per_sec"])
+    methods = {}
+    for method, lats in sorted(best["by_method"].items()):
+        lats.sort()
+        methods[method] = {
+            "count": len(lats),
+            "p50_ms": round(_pctl(lats, 0.50), 3),
+            "p90_ms": round(_pctl(lats, 0.90), 3),
+            "p99_ms": round(_pctl(lats, 0.99), 3),
+        }
+    return {
+        "saturation_per_sec": round(best["goodput_per_sec"], 1),
+        "at_offered_per_sec": best["offered_per_sec"],
+        "ladder": [{"offered_per_sec": leg["offered_per_sec"],
+                    "goodput_per_sec": round(leg["goodput_per_sec"], 1),
+                    "errors_or_sheds": leg["shed"]} for leg in legs],
+        "methods": methods,
+    }
+
+
+def run_storm(rates, duration, seed, locked, corpus):
+    chain, server, addr, dest = build_world(locked)
+    load = InsertLoad(chain, corpus)
+    load.start()
+    try:
+        # let the writer put real blocks (and contention) on the chain
+        while load.blocks < 2:
+            time.sleep(0.01)
+        leg = sweep(server, rates, duration, seed,
+                    "0x" + addr.hex(), "0x" + dest.hex())
+    finally:
+        load.stop_flag.set()
+        load.join(timeout=120)
+        server.stop()
+        chain.stop()
+    leg["writer_blocks_inserted"] = load.blocks
+    leg["writer_corpus_exhausted"] = load.exhausted
+    if load.exhausted:
+        print(f"  NOTE: writer corpus ({len(corpus)} blocks) drained before "
+              "the sweep ended — later rungs ran with less write load",
+              flush=True)
+    return leg
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def main(argv=None):
+    global LockedBackend
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="~2s total: short ladder, short rungs (lint stage)")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="seconds per ladder rung (default 2.0; smoke 0.4)")
+    ap.add_argument("--rates", type=float, nargs="*", default=None,
+                    help="offered-rate ladder, req/s")
+    ap.add_argument("--seed", type=int, default=18)
+    ap.add_argument("--corpus", type=int, default=None,
+                    help="writer insert-corpus size in blocks "
+                         "(default 240; smoke 16)")
+    ap.add_argument("--round", type=int, default=None,
+                    help="write BENCH_STORM_rNN.json next to the repo root")
+    ap.add_argument("--out", default=None, help="explicit artifact path")
+    args = ap.parse_args(argv)
+
+    duration = args.duration or (0.4 if args.smoke else 1.5)
+    rates = args.rates or ([150.0, 600.0] if args.smoke
+                           else [1000.0, 2000.0, 4000.0, 8000.0])
+    n_corpus = args.corpus or (16 if args.smoke else 400)
+
+    LockedBackend = _make_locked_backend()
+    t0 = time.monotonic()
+    corpus = build_corpus(n_corpus)
+    print(f"pregenerated {len(corpus)} writer blocks x "
+          f"{WRITER_TXS_PER_BLOCK} txs in {time.monotonic() - t0:.1f}s "
+          "(outside the measured window)", flush=True)
+    print("storm leg: locked (reads under chainmu, the pre-PR model)",
+          flush=True)
+    locked = run_storm(rates, duration, args.seed, True, corpus)
+    print("storm leg: view (lock-free ReadView reads)", flush=True)
+    view = run_storm(rates, duration, args.seed, False, corpus)
+
+    ratio = (view["saturation_per_sec"] / locked["saturation_per_sec"]
+             if locked["saturation_per_sec"] else 0.0)
+    result = {
+        "schema": "bench-storm/v1",
+        "config": 18,
+        "suite": "bench_storm",
+        "platform": "cpu",
+        "host_mode": True,  # host-concurrency bench: no device code runs
+        "seed": args.seed,
+        "duration_per_rung_s": duration,
+        "smoke": bool(args.smoke),
+        "workers": WORKERS,
+        "logical_clients": N_LOGICAL_CLIENTS,
+        "legs": {"locked": locked, "view": view},
+        "view_vs_locked_saturation": round(ratio, 3),
+    }
+    print(json.dumps({
+        "config": 18, "metric": "storm_view_saturation_per_sec",
+        "value": view["saturation_per_sec"], "unit": "req/s",
+        "vs_baseline": round(ratio, 3),
+    }), flush=True)
+
+    out = args.out
+    if out is None and args.round is not None:
+        out = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), f"BENCH_STORM_r{args.round}.json")
+    if out:
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {out}", flush=True)
+    return result
+
+
+if __name__ == "__main__":
+    main()

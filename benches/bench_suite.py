@@ -23,6 +23,10 @@ Configs (BASELINE.md "measurable baselines"):
   16 resident mesh-width sweep {1,2,4,8} — store/arena rows sharded over
      a device mesh (resident-mesh-devices), CPU default leg first;
      per-shard lane counts + gather bytes ride the flight records
+  17 verify-on-read overhead A/B (storage fault armor)
+  18 open-loop read-traffic storm A/B (bench_storm.py): lock-free
+     ReadView reads vs the chainmu-locked foil under concurrent
+     pipelined insert load — saturation goodput + per-method p99
 
 Each line: {"metric", "value", "unit", "vs_baseline", "config"} where
 vs_baseline compares the accelerated path against the host baseline of
@@ -975,6 +979,26 @@ def bench_17():
           on_rate / off_rate)
 
 
+def bench_18():
+    """Open-loop read-traffic storm (PR 16, BENCH_STORM config): the
+    lock-free ReadView read tier vs the chainmu-locked foil, both under
+    a concurrent pipelined insert load drawn from a pregenerated block
+    corpus. The suite runs bench_storm's abbreviated ladder (the full
+    artifact run is `python benches/bench_storm.py --round NN`); the
+    emitted metric is the view leg's saturation goodput and vs_baseline
+    is view/locked — the lock-discipline win, >1 means the lock-free
+    tier saturates higher. Host-concurrency bench: CPU-only by design,
+    no device leg."""
+    import bench_storm
+
+    result = bench_storm.main(["--duration", "1.0",
+                               "--rates", "1000", "2000", "4000", "8000",
+                               "--corpus", "200"])
+    _emit(18, "storm_view_saturation_per_sec",
+          result["legs"]["view"]["saturation_per_sec"], "req/s",
+          result["view_vs_locked_saturation"])
+
+
 def main():
     from coreth_tpu.utils import enable_compilation_cache
 
@@ -992,7 +1016,7 @@ def main():
     watchdog = PhaseWatchdog(
         time.monotonic() + float(os.environ.get("CORETH_TPU_BENCH_WATCHDOG",
                                                 "1800")))
-    picks = [int(a) for a in sys.argv[1:]] or list(range(1, 18))
+    picks = [int(a) for a in sys.argv[1:]] or list(range(1, 19))
     for i in picks:
         # configs 7/9 run bench.py legs under their own phase watchdogs
         # with larger budgets (900s cold warmup); the outer arm must not

@@ -27,6 +27,11 @@ SA008 backend-isolation  trie/ and bintrie/ may not import each other —
 SA009 fold-order       fold-step loops in the optimistic executor must
                        iterate in tx-index order (range/sorted only) —
                        completion-order folds break deterministic commit
+SA010 read-tier-locks  read-only RPC handler modules (eth/api,
+                       eth/filters, eth/gasprice, eth/backend) must not
+                       touch `chainmu` or call chainmu-taking chain
+                       methods — reads resolve against the published
+                       ReadView, never the write path's lock
 """
 
 from __future__ import annotations
@@ -985,10 +990,76 @@ class FoldOrderRule(Rule):
                 and dotted(node.func) in FOLD_ORDER_SOURCES)
 
 
+# ------------------------------------------------------------------ SA010
+
+# The lock-free read tier (PR 16, ROBUSTNESS.md "Read-path lock
+# discipline"): read-only RPC handler modules resolve heads and state
+# against the chain's atomically published ReadView. Touching `chainmu`
+# from any of them — directly or by calling a chain method that takes it
+# — re-couples read latency to the write pipeline, which is exactly the
+# regression the storm bench measures. The list of chainmu-taking chain
+# methods is curated (they are few and stable); receiver matching is
+# name-based ("chain" in the dotted receiver) so unrelated objects with
+# an `accept` method don't trip it.
+READ_TIER_PATHS = (
+    "coreth_tpu/eth/api.py",
+    "coreth_tpu/eth/filters.py",
+    "coreth_tpu/eth/gasprice.py",
+    "coreth_tpu/eth/backend.py",
+)
+CHAINMU_TAKING_METHODS = {
+    "insert_block", "insert_block_manual", "accept", "reject",
+    "set_preference", "last_consensus_accepted_block",
+}
+
+
+class ReadTierLockRule(Rule):
+    """Read-only RPC handlers must be chainmu-free: no `chainmu`
+    attribute access (with-statements, acquire/release, passing the lock
+    around) and no calls to the curated chainmu-taking chain methods.
+    Justified exceptions go in the baseline with a reason."""
+
+    id = "SA010"
+    title = "read-tier module touches chainmu"
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        if src.relpath not in READ_TIER_PATHS:
+            return iter(())
+        rule = self
+        findings: List[Finding] = []
+
+        class V(QualnameVisitor):
+            def visit_Attribute(self, node: ast.Attribute) -> None:
+                if node.attr == "chainmu":
+                    findings.append(rule.finding(
+                        src, node, self.qualname,
+                        "read-tier module touches `chainmu` — read-only "
+                        "RPC paths resolve against chain.read_view(), "
+                        "never the write path's lock"))
+                self.generic_visit(node)
+
+            def visit_Call(self, node: ast.Call) -> None:
+                fn = node.func
+                if (isinstance(fn, ast.Attribute)
+                        and fn.attr in CHAINMU_TAKING_METHODS):
+                    recv = dotted(fn.value) or ""
+                    if "chain" in recv.lower():
+                        findings.append(rule.finding(
+                            src, node, self.qualname,
+                            f"read-tier module calls chainmu-taking "
+                            f"`{recv}.{fn.attr}()` — reads must not "
+                            f"enter the write path"))
+                self.generic_visit(node)
+
+        V().visit(src.tree)
+        return iter(findings)
+
+
 ALL_RULES: Tuple[type, ...] = (
     SilentExceptRule, LockDisciplineRule, HotPathPurityRule,
     ConsensusFloatRule, UnorderedIterationRule, FailpointHygieneRule,
     ServingBoundednessRule, BackendIsolationRule, FoldOrderRule,
+    ReadTierLockRule,
 )
 
 

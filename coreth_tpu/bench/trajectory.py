@@ -4,7 +4,9 @@ Ingests every per-round bench artifact in the repo root — `BENCH_rNN.json`
 (the config-1 device leg run through the axon tunnel), `BENCH_EARLY_rNN.json`
 (the pre-suite early capture), `BENCH_SUITE_rNN.json` (the bench-suite
 configs), `MULTICHIP_rNN.json` (the 8-device mesh dryrun, parsed from its
-"dryrun_multichip OK" tail lines), `CHAOS_rNN.json` (the chaos conductor's
+"dryrun_multichip OK" tail lines), `BENCH_STORM_rNN.json` (the config-18
+open-loop read storm: per-leg saturation goodput + per-method p99),
+`CHAOS_rNN.json` (the chaos conductor's
 `--json` result: coverage + violation counts, never timings) — normalizes
 each measured leg into a (config, metric, provenance) series across rounds,
 and writes `BENCH_TRAJECTORY.json` with median + MAD noise bands per series.
@@ -159,6 +161,46 @@ def _chaos_points(data: dict, rnd: int,
     return points, []
 
 
+def _storm_points(data: dict, rnd: int,
+                  source: str) -> Tuple[List[dict], List[dict]]:
+    """One BENCH_STORM_rNN.json (the config-18 open-loop read storm) ->
+    per-leg series: saturation goodput (higher-better via per_sec) and
+    per-method p99 at the saturated rung (lower-better via _ms). Both
+    the locked foil and the view leg ingest — the A/B ratio regressing
+    is exactly a lock-discipline leak the sentinel should catch. A
+    smoke-mode artifact is a liveness probe, not a measurement: its
+    rungs are too short for stable percentiles, so it is recorded as
+    skipped rather than polluting the series."""
+    config = data.get("config", 18)
+    if data.get("smoke"):
+        return [], [{
+            "round": rnd, "source": source, "config": config,
+            "metric": "storm", "reason": "smoke artifact (unmeasured)",
+        }]
+    points: List[dict] = []
+    prov = _provenance(data.get("platform"), data.get("host_mode"))
+    for leg_name, leg in sorted((data.get("legs") or {}).items()):
+        sat = leg.get("saturation_per_sec")
+        if isinstance(sat, (int, float)) and sat > 0:
+            points.append({
+                "round": rnd, "source": source, "config": config,
+                "metric": f"storm_{leg_name}_saturation_per_sec",
+                "value": float(sat), "unit": "req/s",
+                "vs_baseline": data.get("view_vs_locked_saturation"),
+                "provenance": prov,
+            })
+        for method, pcts in sorted((leg.get("methods") or {}).items()):
+            p99 = pcts.get("p99_ms")
+            if isinstance(p99, (int, float)) and p99 > 0:
+                points.append({
+                    "round": rnd, "source": source, "config": config,
+                    "metric": f"storm_{leg_name}_{method}_p99_ms",
+                    "value": float(p99), "unit": "ms",
+                    "vs_baseline": None, "provenance": prov,
+                })
+    return points, []
+
+
 def _round_of(path: str) -> Optional[int]:
     m = _ROUND_RE.search(os.path.basename(path))
     return int(m.group(1)) if m else None
@@ -222,6 +264,10 @@ def load_artifacts(root: str) -> Tuple[List[dict], List[dict]]:
             skipped += s
         elif name.startswith("CHAOS_"):
             p, s = _chaos_points(data, rnd, name)
+            points += p
+            skipped += s
+        elif name.startswith("BENCH_STORM_"):
+            p, s = _storm_points(data, rnd, name)
             points += p
             skipped += s
         elif name.startswith("BENCH_SUITE_"):

@@ -306,6 +306,29 @@ class BlockValidator:
             )
 
 
+class ReadView:
+    """Immutable snapshot of the chain's serving surface, published by a
+    single reference swap so readers never take chainmu (ROADMAP 1: the
+    read tier must not contend with the AlDBaran-style write pipeline).
+
+    `accepted` is the coreth "latest" head, `preferred` the "pending"
+    tip, `degraded` the storage-fault rung at publish time. `snap_ready`
+    is the snapshot-attach event captured WITH the heads: a reader waits
+    only for its own view's diff layer, never a later in-flight
+    insert's. `seq` increases with every publication — a reader holding
+    two views can order them without touching the chain."""
+
+    __slots__ = ("accepted", "preferred", "degraded", "seq", "snap_ready")
+
+    def __init__(self, accepted: Block, preferred: Block, degraded: bool,
+                 seq: int, snap_ready: threading.Event):
+        self.accepted = accepted
+        self.preferred = preferred
+        self.degraded = degraded
+        self.seq = seq
+        self.snap_ready = snap_ready
+
+
 class BlockChain:
     def __init__(
         self,
@@ -359,6 +382,17 @@ class BlockChain:
                 "(expected 'mpt' or 'bintrie-shadow')")
 
         self.chainmu = threading.RLock()
+
+        # lock-free read tier: `_read_view` is replaced wholesale (one
+        # reference swap) and never mutated in place; readers grab it
+        # without any lock. Publication serializes on `_view_mu` — NOT
+        # chainmu, because degraded flips publish from the tail worker —
+        # and re-reads the head pointers inside the mutex, so the last
+        # published view always reflects the newest heads (no regression
+        # even with racing publishers).
+        self._view_mu = threading.Lock()
+        self._view_seq = 0
+        self._read_view: Optional[ReadView] = None
 
         self._blocks: Dict[bytes, Block] = {}  # block cache by hash
         self._receipts: Dict[bytes, List[Receipt]] = {}
@@ -572,6 +606,47 @@ class BlockChain:
             self.pipeline = InsertPipeline(
                 self, depth=cache_config.insert_pipeline_depth)
 
+        # first view: the fully restored boot heads
+        self._publish_read_view()
+
+    # ----------------------------------------------------------- read view
+
+    def _publish_read_view(self) -> None:
+        """Publish a fresh ReadView from the current head pointers.
+        Callers: every head/degraded transition (_write_canonical,
+        accept, _reorg, degraded enter/recover, state-sync reset). The
+        pointer reads happen INSIDE _view_mu so two racing publishers
+        cannot leave a stale head as the last-published view."""
+        with self._view_mu:
+            self._view_seq += 1
+            view = ReadView(
+                accepted=self.last_accepted,
+                preferred=self.current_block,
+                degraded=self.degraded,
+                seq=self._view_seq,
+                snap_ready=self._tail_snap_applied,
+            )
+            self._read_view = view
+
+    def read_view(self) -> ReadView:
+        """The current ReadView — a single attribute load, no lock."""
+        return self._read_view
+
+    def state_at_view(self, view: ReadView, root: bytes) -> StateDB:
+        """StateDB resolution pinned to [view]: waits only the view's
+        own snapshot-attach event (captured at publish time), so a read
+        never blocks behind a LATER in-flight insert the way the
+        chain-global state_at() join does. Deliberately does NOT consume
+        tail_error — reads keep serving through a sick tail (the
+        degraded-rung contract); write paths surface the error."""
+        timeout = self.cache_config.tail_join_timeout
+        if not view.snap_ready.wait(timeout if timeout > 0 else None):
+            raise TailStalled(
+                "read-view snapshot attach", timeout,
+                self._tail_queue.unfinished_tasks,
+                worker_error=self.tail_error)
+        return StateDB(root, self.state_database, self.snaps)
+
     # ------------------------------------------------------------- genesis
 
     def _setup_genesis(self, genesis) -> Block:
@@ -710,9 +785,12 @@ class BlockChain:
                 receipts, block.transactions, block_hash, number,
                 block.base_fee, Signer(self.config.chain_id),
             )
-        # cache insert under chainmu: every other _receipts write holds it
-        with self.chainmu:
-            self._receipts[block_hash] = receipts
+        # lock-free cache fill: a single-key store of an immutable list
+        # is atomic under the GIL, and the read tier must not contend on
+        # chainmu for a cache insert. Structural writers (_write_block,
+        # reject) still serialize on chainmu; the worst race here is two
+        # readers deriving the same receipts and one store winning.
+        self._receipts[block_hash] = receipts
         return receipts
 
     def has_block(self, block_hash: bytes) -> bool:
@@ -1290,6 +1368,7 @@ class BlockChain:
             self.degraded = True
         if not first:
             return
+        self._publish_read_view()  # readers see the rung without chainmu
         _metrics.gauge("chain/degraded").update(1)
         _metrics.counter("chain/degraded_entries").inc()
         self.flight_recorder.note_event("chain/degraded", why=why)
@@ -1341,6 +1420,7 @@ class BlockChain:
                 f"chain is degraded read-only; replay failed: {e}") from e
         with self._degraded_mu:
             self.degraded = False
+        self._publish_read_view()
         self.tail_error = None  # surfaced through the rung, not join_tail
         _metrics.gauge("chain/degraded").update(0)
         _metrics.counter("chain/degraded_recoveries").inc()
@@ -1464,6 +1544,7 @@ class BlockChain:
         body-before-head durability ordering."""
         self._canonical[block.number] = block.hash()
         self.current_block = block
+        self._publish_read_view()
         self._tail_queue.put(("head", block))
 
     def reprocess_state(self, target: Block, reexec_limit: int) -> None:
@@ -1584,6 +1665,7 @@ class BlockChain:
             if canonical != block.hash():
                 self._set_preference_locked(block)
             self.last_accepted = block
+            self._publish_read_view()
             with self._acceptor_tip_lock:
                 self._acceptor_tip = block
             self._acceptor_wg.clear()
@@ -1712,6 +1794,7 @@ class BlockChain:
             self._canonical[blk.number] = blk.hash()
             rawdb.write_canonical_hash(self.diskdb, blk.hash(), blk.number)
         self.current_block = new_head
+        self._publish_read_view()
         rawdb.write_head_block_hash(self.diskdb, new_head.hash())
         # a reorg IS a head change: downstream (tx pool) must re-anchor on
         # the new fork, exactly like canonical-extension inserts

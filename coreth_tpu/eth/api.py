@@ -373,7 +373,7 @@ class EthAPI:
 
             to = create_address(
                 msg.from_,
-                self.b.chain.state_at(blk.root).get_nonce(msg.from_))
+                self.b.state_at_root(blk.root).get_nonce(msg.from_))
         exclude = {msg.from_, to, blk.header.coinbase}
         from ..evm.precompiles import active_precompiles
 

@@ -30,7 +30,8 @@ def require_keystore(keystore):
 
 class EthBackend:
     def __init__(self, chain, txpool, allow_unfinalized_queries: bool = False,
-                 keystore=None, external_signer=None, api_max_blocks: int = 0):
+                 keystore=None, external_signer=None, api_max_blocks: int = 0,
+                 gasprice_cache_size: int = 8, logs_cache_size: int = 64):
         self.chain = chain
         self.txpool = txpool
         self.chain_config = chain.config
@@ -42,27 +43,31 @@ class EthBackend:
         # accounts list into eth_accounts; signing for them routes over
         # the daemon's IPC (keystore-external-signer config knob)
         self.external_signer = external_signer
-        self.filters = FilterSystem(self)
-        self.gpo = Oracle(self)
+        self.filters = FilterSystem(self, candidates_cache_size=logs_cache_size)
+        self.gpo = Oracle(self, cache_size=gasprice_cache_size)
 
     # --- heads ------------------------------------------------------------
+    # every accessor resolves against the chain's atomically published
+    # ReadView — no chainmu, no coupling to the write path (SA010)
 
     def last_accepted_block(self) -> Block:
-        return self.chain.last_accepted_block()
+        return self.chain.read_view().accepted
 
     def current_block(self) -> Block:
-        return self.chain.current_block
+        return self.chain.read_view().preferred
 
-    def block_by_tag(self, tag: str) -> Optional[Block]:
+    def _block_in_view(self, view, tag: str) -> Optional[Block]:
+        """Tag resolution against ONE view, so a caller that also needs
+        state sees block and head from the same publication."""
         if tag in ("latest", "accepted"):
-            return self.last_accepted_block()
+            return view.accepted
         if tag == "pending":
             # coreth has no pending block concept at the API: preference tip
-            return self.current_block()
+            return view.preferred
         if tag == "earliest":
             return self.chain.genesis_block
         number = parse_hex(tag)
-        head = self.last_accepted_block().number
+        head = view.accepted.number
         if number > head and not self.allow_unfinalized_queries:
             raise RPCError(
                 -32000,
@@ -70,11 +75,20 @@ class EthBackend:
             )
         return self.chain.get_block_by_number(number)
 
+    def block_by_tag(self, tag: str) -> Optional[Block]:
+        return self._block_in_view(self.chain.read_view(), tag)
+
     def state_at_tag(self, tag: str):
-        blk = self.block_by_tag(tag)
+        view = self.chain.read_view()
+        blk = self._block_in_view(view, tag)
         if blk is None:
             raise RPCError(-32000, "block not found")
-        return self.chain.state_at(blk.root)
+        return self.chain.state_at_view(view, blk.root)
+
+    def state_at_root(self, root: bytes):
+        """View-pinned state at an already-resolved root (callers that
+        hold a block from block_by_tag/do_call)."""
+        return self.chain.state_at_view(self.chain.read_view(), root)
 
     # --- txs --------------------------------------------------------------
 
@@ -126,10 +140,11 @@ class EthBackend:
         (e.g. an access recorder) applied before execution — the ONE
         call-execution recipe shared by eth_call, callDetailed, and
         createAccessList."""
-        blk = self.block_by_tag(tag)
+        view = self.chain.read_view()
+        blk = self._block_in_view(view, tag)
         if blk is None:
             raise RPCError(-32000, "block not found")
-        state = self.chain.state_at(blk.root)
+        state = self.chain.state_at_view(view, blk.root)
         if wrap_state is not None:
             state = wrap_state(state)
         msg = self._call_msg(call_obj, blk.gas_limit)

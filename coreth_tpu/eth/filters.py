@@ -12,8 +12,10 @@ from typing import Callable, Dict, List, Optional
 from ..core.types import bloom_lookup
 from ..metrics import count_drop
 from ..utils.deadline import check as deadline_check
+from .cache import BoundedCache
 
 FILTER_TIMEOUT = 300.0  # 5 min deactivation like filter_system.go
+CANDIDATES_CACHE_SIZE = 64
 
 # deadline checkpoint cadence inside a block scan: often enough that a
 # budget overrun is caught within one batch, rare enough to stay free
@@ -50,9 +52,14 @@ class _Filter:
 class FilterSystem:
     """Installable polling filters + direct getLogs (filters.FilterSystem)."""
 
-    def __init__(self, backend):
+    def __init__(self, backend, candidates_cache_size: int = CANDIDATES_CACHE_SIZE):
         self.b = backend
         self.lock = threading.Lock()
+        # bloom-bit candidate offsets per (section, criteria): candidates
+        # are only consulted for FULLY-indexed sections, whose rows never
+        # change once committed — the key is complete forever, so no
+        # invalidation hook is needed (logs-cache-size knob)
+        self._candidates_cache = BoundedCache("logs", candidates_cache_size)
         self.filters: Dict[str, _Filter] = {}
         # push subscribers: id -> (typ, crit, notify) — the WS
         # eth_subscribe feeds (filter_system.go subscription channels)
@@ -250,7 +257,12 @@ class FilterSystem:
                 and indexer.has_section(section)
             )
             if use_index:
-                offsets = indexer.candidates(section, groups)
+                cache_key = (section, tuple(tuple(g) for g in groups))
+                offsets = self._candidates_cache.get(cache_key)
+                if offsets is None:
+                    offsets = indexer.candidates(section, groups)
+                    if offsets is not None:
+                        self._candidates_cache.put(cache_key, offsets)
                 blocks = [
                     chain.get_block_by_number(sec_lo + int(off))
                     for off in (offsets if offsets is not None else [])

@@ -12,22 +12,32 @@ from typing import List, Optional
 from .. import params
 from ..consensus.dummy import estimate_next_base_fee
 from ..metrics import count_drop
+from .cache import BoundedCache
 
 CHECK_BLOCKS = 20
 PERCENTILE = 60
 MAX_LOOKBACK = 2048
+CACHE_SIZE = 8
 
 
 class Oracle:
     def __init__(self, backend, check_blocks: int = CHECK_BLOCKS,
-                 percentile: int = PERCENTILE):
+                 percentile: int = PERCENTILE, cache_size: int = CACHE_SIZE):
         self.b = backend
         self.check_blocks = check_blocks
         self.percentile = percentile
+        # tips are a pure function of the accepted head (the walk only
+        # touches accepted ancestors, which never change under a hash),
+        # so the head hash is a complete cache key; a reorg of the
+        # preference tip cannot stale it (gasprice-cache-size knob)
+        self._tips_cache = BoundedCache("gasprice", cache_size)
 
     def _recent_tips(self) -> List[int]:
         chain = self.b.chain
         head = self.b.last_accepted_block()
+        cached = self._tips_cache.get(head.hash())
+        if cached is not None:
+            return cached
         tips: List[int] = []
         blk = head
         for _ in range(self.check_blocks):
@@ -39,7 +49,9 @@ class Oracle:
                 if tip >= 0:
                     tips.append(tip)
             blk = chain.get_block(blk.parent_hash)
-        return sorted(tips)
+        tips.sort()
+        self._tips_cache.put(head.hash(), tips)
+        return tips
 
     def suggest_tip_cap(self) -> int:
         tips = self._recent_tips()

@@ -756,7 +756,11 @@ def create_handlers(vm, allow_unfinalized_queries: bool = False) -> RPCServer:
                          external_signer=getattr(vm, "external_signer",
                                                  None),
                          api_max_blocks=(cfg.api_max_blocks_per_request
-                                         if cfg is not None else 0))
+                                         if cfg is not None else 0),
+                         gasprice_cache_size=(cfg.gasprice_cache_size
+                                              if cfg is not None else 8),
+                         logs_cache_size=(cfg.logs_cache_size
+                                          if cfg is not None else 64))
     vm.eth_backend = backend
     server = RPCServer(
         policy=ServingPolicy.from_config(cfg if cfg is not None

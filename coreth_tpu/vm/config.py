@@ -72,6 +72,11 @@ class Config:
     trie_dirty_commit_target: int = 20  # MB
     snapshot_cache: int = 256          # MB
     accepted_cache_size: int = 32
+    # read-tier result caches (eth/cache.py): gasprice oracle tips keyed
+    # by accepted-head hash, and eth_getLogs bloom-index candidate
+    # offsets keyed by (section, criteria). 0 disables a cache
+    gasprice_cache_size: int = 8
+    logs_cache_size: int = 64
 
     # --- eth settings -----------------------------------------------------
     preimages_enabled: bool = False
@@ -384,6 +389,14 @@ class Config:
             raise ValueError(
                 f"api-max-blocks-per-request must be >= 0 "
                 f"(got {self.api_max_blocks_per_request})")
+        if self.gasprice_cache_size < 0:
+            raise ValueError(
+                f"gasprice-cache-size must be >= 0 "
+                f"(got {self.gasprice_cache_size})")
+        if self.logs_cache_size < 0:
+            raise ValueError(
+                f"logs-cache-size must be >= 0 "
+                f"(got {self.logs_cache_size})")
         for knob in ("rpc_max_workers", "rpc_expensive_duration",
                      "rpc_batch_limit", "rpc_body_limit",
                      "rpc_breaker_threshold", "rpc_drain_timeout",

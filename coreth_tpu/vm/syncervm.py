@@ -289,6 +289,10 @@ class StateSyncClient:
                 chain.diskdb, chain.state_database.triedb,
                 blk.root, block_hash=blk.hash(),
             )
+        # the head pointers moved out of band: re-publish the read view
+        # so lock-free readers land on the synced block (and the rebuilt
+        # snapshot tree) rather than the pre-sync heads
+        chain._publish_read_view()
         from .block import BlockStatus, VMBlock
 
         vmb = VMBlock(self.vm, blk)

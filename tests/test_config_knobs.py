@@ -99,6 +99,21 @@ def test_allow_unfinalized_queries_knob():
     vm.shutdown()
 
 
+def test_read_tier_cache_knobs():
+    """gasprice-cache-size / logs-cache-size flow into the read-tier
+    BoundedCaches (PR 16); 0 disables a cache entirely."""
+    vm = boot_vm(**{"gasprice-cache-size": 2, "logs-cache-size": 0})
+    server = create_handlers(vm)
+    gpo_cache = vm.eth_backend.gpo._tips_cache
+    logs_cache = vm.eth_backend.filters._candidates_cache
+    assert gpo_cache.size == 2 and logs_cache.size == 0
+    assert "result" in rpc_raw(server, "eth_gasPrice")
+    assert len(gpo_cache) == 1  # the oracle memoized this head's tip walk
+    logs_cache.put(("section", ()), [1])
+    assert len(logs_cache) == 0  # size 0 = disabled: put is a no-op
+    vm.shutdown()
+
+
 def test_txpool_limits_honored():
     from coreth_tpu.core.txpool import TxPool, TxPoolConfig
     from coreth_tpu.core.types import Signer, Transaction
@@ -235,3 +250,7 @@ def test_validate_rejects_bad_combinations():
             "offline-pruning-enabled": True,
             "pruning-enabled": False,
         }).encode())
+    with pytest.raises(ValueError, match="gasprice-cache-size"):
+        parse_config(b'{"gasprice-cache-size": -1}')
+    with pytest.raises(ValueError, match="logs-cache-size"):
+        parse_config(b'{"logs-cache-size": -2}')

@@ -492,6 +492,61 @@ def test_sa008_quiet_on_shared_deps_and_seam_module():
             if f.rule == "SA008"] == []
 
 
+# ---------------------------------------------------------------- SA010
+
+_SA010_BAD = """
+class EthAPI:
+    def blockNumber(self):
+        with self.b.chain.chainmu:
+            return self.b.chain.current_block
+
+    def forceAccept(self, blk):
+        self.b.chain.accept(blk)
+
+    def consensusHead(self):
+        chain = self.b.chain
+        return chain.last_consensus_accepted_block()
+"""
+
+
+@pytest.mark.parametrize("relpath", [
+    "coreth_tpu/eth/api.py",
+    "coreth_tpu/eth/filters.py",
+    "coreth_tpu/eth/gasprice.py",
+    "coreth_tpu/eth/backend.py",
+])
+def test_sa010_fires_on_chainmu_in_read_tier(relpath):
+    out = [f for f in findings(_SA010_BAD, relpath) if f.rule == "SA010"]
+    assert len(out) == 3
+    assert any("chainmu" in f.message for f in out)
+    assert any("accept" in f.message for f in out)
+
+
+def test_sa010_quiet_outside_read_tier():
+    # the same code is legitimate in chain-mutating modules
+    for relpath in ("coreth_tpu/vm/vm.py", "coreth_tpu/eth/tracers.py",
+                    "coreth_tpu/core/blockchain.py"):
+        assert [f for f in findings(_SA010_BAD, relpath)
+                if f.rule == "SA010"] == []
+
+
+def test_sa010_quiet_on_view_resolution():
+    src = """
+    class EthAPI:
+        def blockNumber(self):
+            return self.b.chain.read_view().accepted.number
+
+        def getBalance(self, addr, tag):
+            return self.b.state_at_tag(tag).get_balance(addr)
+
+        def acceptItem(self, item):
+            # non-chain receivers with colliding method names are fine
+            self.queue.accept(item)
+    """
+    assert [f for f in findings(src, "coreth_tpu/eth/api.py")
+            if f.rule == "SA010"] == []
+
+
 # ------------------------------------------------------------ repo gate
 
 def test_repo_is_clean_modulo_baseline():

@@ -122,6 +122,76 @@ class TestIngestion:
         assert points == [] and skipped == []
 
 
+def _storm(tmp_path, rnd, view_sat=3400.0, locked_sat=2900.0,
+           view_p99=300.0, smoke=False):
+    legs = {
+        "locked": {"saturation_per_sec": locked_sat,
+                   "methods": {"eth_getBalance": {
+                       "count": 100, "p50_ms": 280.0, "p90_ms": 530.0,
+                       "p99_ms": 570.0}}},
+        "view": {"saturation_per_sec": view_sat,
+                 "methods": {"eth_getBalance": {
+                     "count": 100, "p50_ms": 240.0, "p90_ms": 290.0,
+                     "p99_ms": view_p99}}},
+    }
+    (tmp_path / f"BENCH_STORM_r{rnd:02d}.json").write_text(json.dumps({
+        "schema": "bench-storm/v1", "config": 18, "platform": "cpu",
+        "host_mode": True, "smoke": smoke, "legs": legs,
+        "view_vs_locked_saturation": round(view_sat / locked_sat, 3)}))
+
+
+class TestStormIngestion:
+    def test_storm_artifact_yields_per_leg_series(self, tmp_path):
+        _storm(tmp_path, 13)
+        points, skipped = load_artifacts(str(tmp_path))
+        assert skipped == []
+        by_metric = {p["metric"]: p for p in points}
+        assert set(by_metric) == {
+            "storm_locked_saturation_per_sec",
+            "storm_locked_eth_getBalance_p99_ms",
+            "storm_view_saturation_per_sec",
+            "storm_view_eth_getBalance_p99_ms",
+        }
+        # a host-concurrency bench: no device code ran
+        assert all(p["provenance"] == "host_mode" for p in points)
+        assert all(p["config"] == 18 for p in points)
+        assert by_metric["storm_view_saturation_per_sec"][
+            "vs_baseline"] == 1.172
+        out = build_trajectory(points, skipped)
+        sat = out["series"]["cfg=18|storm_view_saturation_per_sec|host_mode"]
+        p99 = out["series"][
+            "cfg=18|storm_view_eth_getBalance_p99_ms|host_mode"]
+        assert sat["direction"] == "higher"   # goodput: more is better
+        assert p99["direction"] == "lower"    # tail latency: less is better
+
+    def test_smoke_storm_is_skipped_not_a_point(self, tmp_path):
+        _storm(tmp_path, 14, smoke=True)
+        points, skipped = load_artifacts(str(tmp_path))
+        assert points == []
+        assert len(skipped) == 1
+        assert "smoke" in skipped[0]["reason"]
+
+    def test_p99_blowup_fails_check(self, tmp_path):
+        # noise-aware gate on the storm series: p99 is lower-is-better,
+        # so a 2x tail-latency blowup in the newest round must trip it
+        for rnd, p99 in ((1, 300.0), (2, 310.0), (3, 295.0), (4, 640.0)):
+            _storm(tmp_path, rnd, view_p99=p99)
+        assert main(["--check", "--root", str(tmp_path)]) == 1
+        out = json.loads((tmp_path / OUTPUT).read_text())
+        assert any("storm_view_eth_getBalance_p99_ms" in r["series"]
+                   for r in out["regressions"])
+
+    def test_saturation_collapse_fails_check(self, tmp_path):
+        for rnd, sat in ((1, 3400.0), (2, 3450.0), (3, 3380.0), (4, 2100.0)):
+            _storm(tmp_path, rnd, view_sat=sat)
+        assert main(["--check", "--root", str(tmp_path)]) == 1
+
+    def test_stable_storm_rounds_pass(self, tmp_path):
+        for rnd, sat in ((1, 3400.0), (2, 3450.0), (3, 3380.0), (4, 3420.0)):
+            _storm(tmp_path, rnd, view_sat=sat)
+        assert main(["--check", "--root", str(tmp_path)]) == 0
+
+
 class TestRegressionGate:
     def test_twenty_percent_regression_fails_check(self, tmp_path, capsys):
         for rnd, v in ((1, 1000.0), (2, 1010.0), (3, 995.0), (4, 800.0)):

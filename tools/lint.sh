@@ -7,7 +7,7 @@ cd "$(dirname "$0")/.."
 
 rc=0
 
-echo "== coreth_tpu.analysis (AST lint: SA001-SA005, baseline-gated) =="
+echo "== coreth_tpu.analysis (AST lint: SA001-SA010, baseline-gated) =="
 python -m coreth_tpu.analysis || rc=1
 
 echo
@@ -28,6 +28,17 @@ if python -c "import jax" >/dev/null 2>&1; then
         || rc=1
 else
     echo "chaos smoke: jax not installed; skipping"
+fi
+
+echo
+echo "== benches/bench_storm.py --smoke (~2s open-loop read-storm smoke) =="
+# liveness probe for the lock-free read tier + PR-7 overload stack, not
+# a measurement (smoke artifacts are excluded from the trajectory);
+# skips cleanly when jax is unavailable in the lint image
+if python -c "import jax" >/dev/null 2>&1; then
+    JAX_PLATFORMS=cpu python benches/bench_storm.py --smoke || rc=1
+else
+    echo "storm smoke: jax not installed; skipping"
 fi
 
 echo
