@@ -27,6 +27,9 @@ Configs (BASELINE.md "measurable baselines"):
   18 open-loop read-traffic storm A/B (bench_storm.py): lock-free
      ReadView reads vs the chainmu-locked foil under concurrent
      pipelined insert load — saturation goodput + per-method p99
+  19 forked execution-shard sweep {1,2,4} vs serial — GIL-free worker
+     processes shipping speculative write-sets; conflict-corpus and
+     pipelined (depth-2) legs; cores stamped for honest provenance
 
 Each line: {"metric", "value", "unit", "vs_baseline", "config"} where
 vs_baseline compares the accelerated path against the host baseline of
@@ -98,7 +101,9 @@ def _block_insert_rate(resident: bool = False, state_backend: str = "mpt",
                        template_residency: bool = False,
                        insert_pipeline_depth: int = 0,
                        per_block: int = 500, mesh_devices: int = 0,
-                       db_verify_on_read: bool = False):
+                       db_verify_on_read: bool = False,
+                       exec_shards: int = 0,
+                       conflict_corpus: bool = False):
     """1k-tx block processing: build the blocks, then time insert_block
     (ecrecover via the native batch + EVM + state commit). Returns
     (n_txs, txs_per_sec). resident=True routes the account trie through
@@ -113,7 +118,11 @@ def _block_insert_rate(resident: bool = False, state_backend: str = "mpt",
     overlaps recover/execute of block k+1 with commit/write of block k —
     the timed region includes the pipeline drain so queued speculation
     can't flatter the rate). per_block sets txs per generated block
-    (smaller blocks -> more blocks -> more stage handoffs to overlap)."""
+    (smaller blocks -> more blocks -> more stage handoffs to overlap).
+    exec_shards>0 dispatches speculation to forked GIL-free worker
+    processes (config-19 A/Bs it vs serial); conflict_corpus=True makes
+    every 4th tx a shared-slot contract call, the shape whose stale
+    shipped reads force parent-side re-execution."""
     from coreth_tpu import params
     from coreth_tpu.consensus.dummy import new_dummy_engine
     from coreth_tpu.core.blockchain import BlockChain, CacheConfig
@@ -130,17 +139,27 @@ def _block_insert_rate(resident: bool = False, state_backend: str = "mpt",
     addrs = [priv_to_address(k) for k in keys]
     signer = Signer(43112)
 
+    # sstore(calldata[0], calldata[32]); sstore(0, sload(0)+1); stop —
+    # every call bumps slot 0, the conflict shape config-19's leg needs
+    counter_code = bytes.fromhex(
+        "6000356020359055600054600101600055") + b"\x00"
+    counter_addr = b"\xc0" * 19 + b"\x01"
+    alloc = {a: GenesisAccount(balance=10**21) for a in addrs}
+    if conflict_corpus:
+        alloc[counter_addr] = GenesisAccount(balance=0, code=counter_code)
+
     diskdb = MemoryDB()
     genesis = Genesis(
         config=params.TEST_CHAIN_CONFIG,
         gas_limit=params.CORTINA_GAS_LIMIT,
-        alloc={a: GenesisAccount(balance=10**21) for a in addrs},
+        alloc=alloc,
     )
     chain = BlockChain(
         diskdb,
         CacheConfig(pruning=True, resident_account_trie=resident,
                     state_backend=state_backend,
                     evm_parallel_workers=parallel_workers,
+                    evm_exec_shards=exec_shards,
                     resident_pipeline_depth=pipeline_depth,
                     resident_template_residency=template_residency,
                     insert_pipeline_depth=insert_pipeline_depth,
@@ -166,11 +185,19 @@ def _block_insert_rate(resident: bool = False, state_backend: str = "mpt",
     def gen(i, bg):
         bf = bg.base_fee() or params.APRICOT_PHASE3_INITIAL_BASE_FEE
         for j in range(i * per_block, min((i + 1) * per_block, n_txs)):
-            tx = Transaction(
-                type=2, chain_id=43112, nonce=0, max_fee=bf * 2,
-                max_priority_fee=0, gas=21000,
-                to=(0x8000 + j).to_bytes(20, "big"), value=1,
-            )
+            if conflict_corpus and j % 4 == 0:
+                data = (j % 2).to_bytes(32, "big") + j.to_bytes(32, "big")
+                tx = Transaction(
+                    type=2, chain_id=43112, nonce=0, max_fee=bf * 2,
+                    max_priority_fee=0, gas=100_000, to=counter_addr,
+                    value=0, data=data,
+                )
+            else:
+                tx = Transaction(
+                    type=2, chain_id=43112, nonce=0, max_fee=bf * 2,
+                    max_priority_fee=0, gas=21000,
+                    to=(0x8000 + j).to_bytes(20, "big"), value=1,
+                )
             bg.add_tx(signer.sign(tx, keys[j]))
 
     blocks, _ = generate_chain(
@@ -999,6 +1026,79 @@ def bench_18():
           result["view_vs_locked_saturation"])
 
 
+def bench_19():
+    """Forked execution-shard A/B (config-19, PERF.md r14): the
+    config-14 disjoint-sender insert workload, CPU serial leg FIRST,
+    then under exec-shard counts {1,2,4} — GIL-free forked workers
+    executing speculative txs and shipping write-sets back over pipes.
+    Counter deltas (dispatches/fallbacks/crashes/respawns) guard against
+    the engine silently bailing: a sweep whose blocks all fell back is
+    measuring serial twice, and the per-leg shard/serial block split
+    says so. Two extra legs: the conflict-shaped corpus (every 4th tx a
+    shared-slot contract call — stale shipped reads force parent-side
+    re-execution, the honest cost of speculation) and the config-15
+    depth-2 pipeline rerun with shards in the submit stage. The
+    companion line stamps os.cpu_count() as provenance: on a single-core
+    box the honest expectation is ~1.0x (fork + pipe overhead buys no
+    parallelism), and the number is reported, not gated away."""
+    from coreth_tpu.metrics import default_registry
+
+    counter_names = ("exec/shard/dispatches", "exec/shard/fallbacks",
+                     "exec/shard/crashes", "exec/shard/respawns")
+
+    def _snap():
+        return {n: default_registry.counter(n).count()
+                for n in counter_names}
+
+    _, serial_rate = _block_insert_rate()
+    sweep = {}
+    best_rate, best_width = 0.0, 0
+    for shards in (1, 2, 4):
+        c0 = _snap()
+        _, rate = _block_insert_rate(exec_shards=shards)
+        c1 = _snap()
+        modes = [r.get("parallel", {}).get("mode")
+                 for r in _LAST_INSERT_INFO.get("flight", [])]
+        sweep[shards] = {
+            "txs_per_sec": round(rate, 1),
+            "ratio_vs_serial": round(rate / serial_rate, 3),
+            "shard_blocks": modes.count("shards"),
+            "serial_blocks": len(modes) - modes.count("shards"),
+            "counters": {n.rsplit("/", 1)[1]: c1[n] - c0[n]
+                         for n in counter_names},
+        }
+        if rate > best_rate:
+            best_rate, best_width = rate, shards
+    # conflict-shaped corpus at the best width (smaller blocks keep the
+    # call-heavy shape under the block gas limit)
+    _, c_serial = _block_insert_rate(per_block=250, conflict_corpus=True)
+    _, c_rate = _block_insert_rate(per_block=250, conflict_corpus=True,
+                                   exec_shards=max(best_width, 2))
+    # config-15 rerun: depth-2 pipeline with the shard submit stage
+    _, p_serial = _block_insert_rate(insert_pipeline_depth=2, per_block=125)
+    _, p_rate = _block_insert_rate(insert_pipeline_depth=2, per_block=125,
+                                   exec_shards=max(best_width, 2))
+    print(json.dumps({
+        "config": 19,
+        "host_mode": True,  # CPU-process bench: no device leg by design
+        "cores": os.cpu_count(),
+        "serial_txs_per_sec": round(serial_rate, 1),
+        "shards": sweep,
+        "conflict_leg": {
+            "serial_txs_per_sec": round(c_serial, 1),
+            "sharded_txs_per_sec": round(c_rate, 1),
+            "ratio_vs_serial": round(c_rate / c_serial, 3),
+        },
+        "pipelined_leg": {
+            "depth2_txs_per_sec": round(p_serial, 1),
+            "depth2_sharded_txs_per_sec": round(p_rate, 1),
+            "ratio": round(p_rate / p_serial, 3),
+        },
+    }), flush=True)
+    _emit(19, "sharded_block_insert_txs_per_sec", best_rate, "txs/s",
+          best_rate / serial_rate)
+
+
 def main():
     from coreth_tpu.utils import enable_compilation_cache
 
@@ -1016,7 +1116,7 @@ def main():
     watchdog = PhaseWatchdog(
         time.monotonic() + float(os.environ.get("CORETH_TPU_BENCH_WATCHDOG",
                                                 "1800")))
-    picks = [int(a) for a in sys.argv[1:]] or list(range(1, 19))
+    picks = [int(a) for a in sys.argv[1:]] or list(range(1, 20))
     for i in picks:
         # configs 7/9 run bench.py legs under their own phase watchdogs
         # with larger budgets (900s cold warmup); the outer arm must not

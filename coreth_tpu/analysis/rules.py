@@ -32,6 +32,12 @@ SA010 read-tier-locks  read-only RPC handler modules (eth/api,
                        touch `chainmu` or call chainmu-taking chain
                        methods — reads resolve against the published
                        ReadView, never the write path's lock
+SA011 shard-worker-isolation  modules imported inside forked execution
+                       shards (core/shard_worker.py) must stay fork-clean:
+                       no metrics/blockchain imports, no `chainmu`, no
+                       `default_registry`, no module-level mutable state —
+                       module scope is stdlib + coreth_tpu.fault only,
+                       EVM machinery is imported lazily per request
 """
 
 from __future__ import annotations
@@ -1055,11 +1061,157 @@ class ReadTierLockRule(Rule):
         return iter(findings)
 
 
+# ------------------------------------------------------------------ SA011
+
+# Execution-shard workers (core/exec_shards.py) fork long-lived children
+# whose import graph is whatever core/shard_worker.py pulls in at module
+# scope. Anything mutable that crosses the fork silently diverges from
+# the parent: counters bumped into a registry nobody scrapes, a chainmu
+# whose other holders don't exist in the child, dicts that look shared
+# but aren't. The contract: worker modules keep module scope down to
+# stdlib + coreth_tpu.fault (which re-arms itself via child_after_fork),
+# never name the metrics registry or the chain lock, hold no module-level
+# mutable state, and import the EVM machinery lazily inside handlers —
+# pickle-clean and side-effect-free by construction.
+SHARD_WORKER_PATHS = (
+    "coreth_tpu/core/shard_worker.py",
+)
+# internal packages a worker file may not import at ANY level — each one
+# drags in a parent-process singleton (metrics registry, chain + chainmu)
+SHARD_WORKER_BANNED_MODULES = {"metrics", "blockchain"}
+# documented exceptions for module-level mutable bindings (none today;
+# additions need a reason next to the name)
+SHARD_WORKER_MUTABLE_ALLOWLIST: frozenset = frozenset()
+_MUTABLE_CTOR_NAMES = {"dict", "list", "set", "bytearray", "defaultdict",
+                       "deque", "Counter", "OrderedDict"}
+
+
+def _import_segments(node: ast.AST) -> List[str]:
+    """All dotted segments named by an import statement."""
+    segs: List[str] = []
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            segs.extend(alias.name.split("."))
+    elif isinstance(node, ast.ImportFrom):
+        if node.module:
+            segs.extend(node.module.split("."))
+        # `from .. import fault` names the target in the alias list
+        if node.level > 0:
+            segs.extend(a.name for a in node.names)
+    return segs
+
+
+def _is_mutable_value(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.Set,
+                         ast.DictComp, ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        name = dotted(node.func) or ""
+        return name.split(".")[-1] in _MUTABLE_CTOR_NAMES
+    return False
+
+
+class ShardWorkerIsolationRule(Rule):
+    """Shard-worker-importable modules must be fork-clean: no imports of
+    the metrics or blockchain packages anywhere in the file, no `chainmu`
+    attribute access, no `default_registry`, module-level imports limited
+    to stdlib + coreth_tpu.fault, and no module-level mutable bindings
+    outside the (empty) allowlist."""
+
+    id = "SA011"
+    title = "shard-worker module breaks fork isolation"
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        if src.relpath not in SHARD_WORKER_PATHS:
+            return iter(())
+        rule = self
+        findings: List[Finding] = []
+
+        def _relative_is_fault_only(node: ast.ImportFrom) -> bool:
+            if node.module in (None, ""):
+                return all(a.name == "fault" for a in node.names)
+            parts = node.module.split(".")
+            return parts[0] == "fault"
+
+        # module-scope statements: imports + bindings
+        for stmt in src.tree.body:
+            if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                relative = (isinstance(stmt, ast.ImportFrom)
+                            and stmt.level > 0)
+                internal = relative or any(
+                    s == "coreth_tpu" for s in _import_segments(stmt))
+                ok = (not internal) or (
+                    isinstance(stmt, ast.ImportFrom) and relative
+                    and _relative_is_fault_only(stmt))
+                if not ok:
+                    findings.append(rule.finding(
+                        src, stmt, "<module>",
+                        "shard-worker module imports project code at "
+                        "module scope — only stdlib and coreth_tpu.fault "
+                        "may load at fork time; import the EVM machinery "
+                        "lazily inside the request handler"))
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                value = stmt.value
+                names = [dotted(t) or "" for t in targets]
+                if (value is not None and _is_mutable_value(value)
+                        and not all(n in SHARD_WORKER_MUTABLE_ALLOWLIST
+                                    for n in names)):
+                    findings.append(rule.finding(
+                        src, stmt, "<module>",
+                        f"module-level mutable binding "
+                        f"`{', '.join(names)}` in a shard-worker module "
+                        f"— state copied through fork diverges silently; "
+                        f"keep it per-request or thread it through the "
+                        f"pipe protocol"))
+
+        class V(QualnameVisitor):
+            def visit_Import(self, node: ast.Import) -> None:
+                self._check_import(node)
+
+            def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+                self._check_import(node)
+
+            def _check_import(self, node: ast.AST) -> None:
+                banned = SHARD_WORKER_BANNED_MODULES.intersection(
+                    _import_segments(node))
+                if banned:
+                    findings.append(rule.finding(
+                        src, node, self.qualname,
+                        f"shard-worker module imports "
+                        f"`{'`, `'.join(sorted(banned))}` — forked "
+                        f"workers must never touch the parent's metrics "
+                        f"registry or chain singletons, even lazily"))
+
+            def visit_Attribute(self, node: ast.Attribute) -> None:
+                if node.attr == "chainmu":
+                    findings.append(rule.finding(
+                        src, node, self.qualname,
+                        "shard-worker module touches `chainmu` — the "
+                        "child's copy of the lock has no other holders; "
+                        "workers are lock-free by construction"))
+                self.generic_visit(node)
+
+            def visit_Name(self, node: ast.Name) -> None:
+                if node.id == "default_registry":
+                    findings.append(rule.finding(
+                        src, node, self.qualname,
+                        "shard-worker module names `default_registry` — "
+                        "counts bumped in a forked child are invisible "
+                        "to the parent's scrapes; ship facts over the "
+                        "pipe instead"))
+                self.generic_visit(node)
+
+        V().visit(src.tree)
+        return iter(findings)
+
+
 ALL_RULES: Tuple[type, ...] = (
     SilentExceptRule, LockDisciplineRule, HotPathPurityRule,
     ConsensusFloatRule, UnorderedIterationRule, FailpointHygieneRule,
     ServingBoundednessRule, BackendIsolationRule, FoldOrderRule,
-    ReadTierLockRule,
+    ReadTierLockRule, ShardWorkerIsolationRule,
 )
 
 

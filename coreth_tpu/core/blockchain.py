@@ -145,6 +145,11 @@ class CacheConfig:
     # Block-STM optimistic parallel execution workers (core/parallel_exec);
     # 0 = seed serial loop. CORETH_TPU_EVM_PARALLEL overrides per-process.
     evm_parallel_workers: int = 0
+    # GIL-free process-level execution shards (core/exec_shards): forked
+    # worker processes execute speculative txs and ship write-sets back;
+    # 0 = in-process paths only. Checked before evm_parallel_workers;
+    # CORETH_TPU_EVM_EXEC_SHARDS overrides per-process.
+    evm_exec_shards: int = 0
     # per-chain flight recorder: ring size of retained per-block phase
     # records (metrics/flight.py; served by debug_blockFlightRecord)
     flight_recorder_size: int = 64
@@ -420,7 +425,8 @@ class BlockChain:
 
         self.processor = StateProcessor(
             config, self, engine,
-            parallel_workers=cache_config.evm_parallel_workers)
+            parallel_workers=cache_config.evm_parallel_workers,
+            exec_shards_n=cache_config.evm_exec_shards)
         self.validator = BlockValidator(config, self, engine)
         if cache_config.pruning:
             self.trie_writer = CappedMemoryTrieWriter(
@@ -1818,6 +1824,9 @@ class BlockChain:
         # acceptor/tail queues being drained below
         if self.pipeline is not None:
             self.pipeline.stop()
+        # then the execution shard pool (the pipeline's submit stage was
+        # its last possible dispatcher)
+        self.processor.close()
         self.drain_acceptor_queue()
         self._acceptor_queue.put(None)
         self._acceptor_thread.join(timeout=5)

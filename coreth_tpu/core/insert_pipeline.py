@@ -172,7 +172,7 @@ class _Entry:
 
     __slots__ = ("block", "hash", "header", "parent_header", "rec", "ctx",
                  "phases", "results", "coinbase", "base", "overlay",
-                 "spec_iv")
+                 "spec_iv", "spec_shards")
 
     def __init__(self, block: Block, parent_header: Header, rec: dict,
                  ctx) -> None:
@@ -196,6 +196,9 @@ class _Entry:
         # wall-clock interval of the speculative execute stage, for the
         # chain-level overlap fraction in the flight record
         self.spec_iv: Optional[Tuple[float, float]] = None
+        # worker count when forked exec shards ran this block's
+        # speculation; 0 = in-process serial speculation
+        self.spec_shards: int = 0
 
 
 class InsertPipeline:
@@ -417,6 +420,32 @@ class InsertPipeline:
         env = _ExecEnv(chain.config, EvmConfig(), block_ctx, txs, msgs,
                        _VersionedTable(), entry.base,
                        budget=max(4, len(txs)))
+        results = self._execute_speculative(entry, env, txs)
+        entry.results = results
+        entry.coinbase = block_ctx.coinbase
+        accounts, storage, barriers = _flatten_write_sets(results)
+        entry.overlay = _OverlayBase(accounts, storage, barriers, entry.base)
+
+    def _execute_speculative(self, entry: _Entry, env: _ExecEnv,
+                             txs) -> List:
+        """The submit stage's execution engine: the in-order in-process
+        loop, or — when the chain runs execution shards — a GIL-free
+        dispatch through the processor's shard pool. Either way the
+        product is the same dense per-tx `_TxResult` list; shard-path
+        failures abort speculation (serial fallback at commit), never
+        the insert."""
+        from .exec_shards import MIN_SHARD_TXS, run_shard_incarnations
+
+        pool = self.chain.processor.shard_pool()
+        if pool is not None and len(txs) >= MIN_SHARD_TXS:
+            # the sweep inside run_shard_incarnations re-executes (in
+            # this thread, against the overlay base) every tx whose
+            # shipped reads turned stale — restoring exactly the
+            # in-order loop's "reads are final" guarantee
+            if not run_shard_incarnations(pool, env):
+                raise _SpecAbort("shard sweep failed")
+            entry.spec_shards = len(pool.workers)
+            return [env.results[i] for i in range(len(txs))]
         results: List = []
         for i in range(len(txs)):
             r = _run_incarnation(env, i, 0)
@@ -426,10 +455,7 @@ class InsertPipeline:
                 raise _SpecAbort(f"tx {i}: {type(r.err).__name__}")
             env.table.publish(i, 0, r.ws)
             results.append(r)
-        entry.results = results
-        entry.coinbase = block_ctx.coinbase
-        accounts, storage, barriers = _flatten_write_sets(results)
-        entry.overlay = _OverlayBase(accounts, storage, barriers, entry.base)
+        return results
 
     def _window_block_ctx(self, entry: _Entry):
         """new_block_context with BLOCKHASH resolving in-flight ancestors
@@ -616,7 +642,8 @@ class InsertPipeline:
                 chain.engine.finalize(chain.config, block,
                                       entry.parent_header, statedb, receipts)
             rec = entry.rec
-            rec["parallel"] = {"mode": "pipeline-spec"}
+            rec["parallel"] = {"mode": "pipeline-spec",
+                               "shards": entry.spec_shards}
             with _PhaseClock("validate", entry.phases, _metrics):
                 chain.validator.validate_state(block, statedb, receipts,
                                                used_gas)

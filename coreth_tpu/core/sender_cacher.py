@@ -17,6 +17,7 @@ k's — exactly the stall the pipeline exists to remove.
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -44,6 +45,30 @@ class TxSenderCacher:
         # batch token -> outstanding futures for that recover() call
         self._batches: Dict[int, list] = {}  # guarded-by: _lock
         self._tokens = itertools.count(1)
+        # fork guard (exec shards, core/exec_shards.py): fork copies only
+        # the calling thread, so an inherited ThreadPoolExecutor is a
+        # threadless shell — submit() would queue work nobody runs and
+        # wait() would hang forever on it
+        self._owner_pid = os.getpid()  # guarded-by: _lock
+
+    def _ensure_pool(self) -> None:
+        """Respawn-after-fork guard: if this cacher object crossed a
+        fork, its pool's worker threads did not — submits would queue
+        work nobody runs and waits would hang. Rebuild the pool (and
+        drop the parent's futures — they can never complete here) before
+        any dispatch or join. The unlocked pre-check is benign: the pid
+        only changes across fork, and a forked child starts single-
+        threaded."""
+        if os.getpid() == self._owner_pid:
+            return
+        with self._lock:
+            pid = os.getpid()
+            if pid == self._owner_pid:
+                return
+            _metrics.counter("exec/shard/fork_guard_trips").inc()
+            self._pool = ThreadPoolExecutor(max_workers=self.threads)
+            self._batches.clear()
+            self._owner_pid = pid
 
     def recover(self, signer: Signer, txs: List[Transaction]) -> Optional[int]:
         """Kick off sender recovery for txs; results land in each tx's
@@ -51,6 +76,7 @@ class TxSenderCacher:
         token for wait(token) (None when there was nothing to do)."""
         if not txs:
             return None
+        self._ensure_pool()
         # prune finished batches so the fire-and-forget path stays bounded
         with self._lock:
             for tok in [t for t, fs in self._batches.items()
@@ -116,6 +142,7 @@ class TxSenderCacher:
         batch when token is None. A token that already completed (or was
         pruned, or is None from an empty recover) is a no-op — senders
         for those txs are cached either way."""
+        self._ensure_pool()
         with self._lock:
             if token is None:
                 futures = [f for fs in self._batches.values() for f in fs]

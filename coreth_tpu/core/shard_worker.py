@@ -1,0 +1,192 @@
+"""Child side of the process-level execution shards (core/exec_shards).
+
+This module is the ONLY code that runs at a shard worker's top level, and
+it is held to the SA011 isolation contract: module-level imports are
+stdlib + `coreth_tpu.fault` only (the sanctioned failpoint home), no
+module-level mutable state, and no touching of the parent's concurrency
+surface — chainmu, the metrics registry singletons, thread pools. The
+heavyweight EVM machinery (`parallel_exec`, `evm.evm`) is imported
+lazily inside the exec handler, where it runs on the child's own
+copy-on-write image.
+
+Protocol (one duplex Pipe per worker, strict request/response, child is
+single-threaded):
+
+    parent -> child   ("ping",)            liveness + fork-guard probe
+                      ("exit",)            clean retirement
+                      ("crash",)           hard os._exit (chaos drills)
+                      ("exec", req)        execute assigned tx indices
+    child  -> parent  ("pong", index, pid, stale_threads)
+                      ("read", kind, ...)  base-state miss, served by the
+                                           parent from its _BaseReader /
+                                           overlay / BLOCKHASH resolver
+                      ("done", results)    per-tx result tuples
+                      ("done_error", r)    results failed to pickle
+
+Each assigned tx executes incarnation 0 against an EMPTY multi-version
+table: every read resolves to BASE and is recorded as such, so the
+parent's `_final_sweep` validates the recorded versions against the real
+table (which holds every tx's published write-set) and re-executes, in
+the parent, exactly the txs that read something a lower-indexed tx
+wrote. Distributed incarnation-0 + the existing deterministic serial
+validation sweep — no new trust, bit-exact by the same argument as
+Block-STM's.
+
+Fork/fault contract: the worker fires `exec/shard_crash` once per exec
+request. A `raise` spec hard-exits the process (indistinguishable from a
+crash to the parent); a `hang` spec parks the child so SIGKILL drills
+can take it down mid-block. Arming is inherited through fork — either
+from `CORETH_TPU_FAILPOINTS` or anything armed in the parent before the
+pool forked — which is what makes the drills env-replayable.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from .. import fault
+
+# exit code for a failpoint-induced hard death; distinct from a SIGKILL's
+# negative exitcode but equally "no cleanup ran"
+CRASH_EXIT = 13
+
+
+class _PipeBase:
+    """`_BaseReader`-shaped read source that answers from the prefetch
+    cache and serves misses over the pipe. Memoised: each (kind, key) is
+    one round-trip for the life of the exec request."""
+
+    __slots__ = ("conn", "accounts", "slots", "codes")
+
+    def __init__(self, conn, prefetch):
+        self.conn = conn
+        self.accounts = dict(prefetch.get("accounts", {}))
+        self.slots = dict(prefetch.get("slots", {}))
+        self.codes = dict(prefetch.get("codes", {}))
+
+    def _rpc(self, kind, *args):
+        self.conn.send(("read", kind) + args)
+        _tag, val = self.conn.recv()
+        return val
+
+    def account(self, addr):
+        """(nonce, balance, code_hash, is_multi_coin) or None (absent)."""
+        if addr in self.accounts:
+            return self.accounts[addr]
+        v = self._rpc("account", addr)
+        self.accounts[addr] = v
+        return v
+
+    def slot(self, addr, key):
+        sk = (addr, key)
+        v = self.slots.get(sk)
+        if v is None:
+            v = self._rpc("slot", addr, key)
+            self.slots[sk] = v
+        return v
+
+    def code(self, addr):
+        c = self.codes.get(addr)
+        if c is None:
+            c = self._rpc("code", addr)
+            self.codes[addr] = c
+        return c
+
+
+def _handle_exec(conn, chain_config, req) -> None:
+    # the per-request crash site: raise -> hard exit (the parent sees a
+    # dead pipe, exactly like a real crash); hang -> parked, SIGKILL-able
+    try:
+        fault.failpoint("exec/shard_crash")
+    except fault.FailpointError:
+        os._exit(CRASH_EXIT)
+
+    from ..evm.evm import EVM, BlockContext, TxContext
+    from .parallel_exec import (
+        _RecordingGasPool,
+        _VersionedTable,
+        VersionedStateView,
+    )
+    from .state_transition import apply_message
+
+    def get_hash(n, conn=conn):
+        conn.send(("read", "blockhash", n))
+        _tag, val = conn.recv()
+        return val
+
+    block_ctx = BlockContext(
+        coinbase=req["coinbase"],
+        block_number=req["number"],
+        time=req["time"],
+        difficulty=req["difficulty"],
+        gas_limit=req["gas_limit"],
+        base_fee=req["base_fee"],
+        get_hash=get_hash,
+    )
+    base = _PipeBase(conn, req["prefetch"])
+    # deliberately EMPTY and never published to: every read resolves to
+    # BASE, and the parent's sweep validates those versions for real
+    table = _VersionedTable()
+    evm = EVM(block_ctx, TxContext(), None, chain_config, req["vm_config"])
+    coinbase = req["coinbase"]
+    msgs = req["msgs"]
+
+    out = []
+    for i in req["indices"]:
+        msg = msgs[i]
+        view = VersionedStateView(table, base, i, coinbase)
+        gp = _RecordingGasPool()
+        evm.reset(TxContext(origin=msg.from_, gas_price=msg.gas_price), view)
+        try:
+            result = apply_message(evm, msg, gp)
+            ws = view.build_write_set()
+            out.append((
+                i, None,
+                (ws.accounts, ws.storage, ws.barriers, ws.logs,
+                 ws.preimages, ws.fee),
+                view.reads, gp.ops,
+                (result.used_gas,
+                 repr(result.err) if result.err is not None else None,
+                 result.return_data),
+            ))
+        except Exception as exc:
+            # speculative failure (coinbase read, validation error, …):
+            # ship the marker; the parent leaves the slot empty and its
+            # sweep re-executes tx i against final state
+            err_repr = repr(exc)
+            out.append((i, err_repr, None, None, None, None))
+    try:
+        conn.send(("done", out))
+    except Exception as exc:
+        # unpicklable write-set member — reduce to an error the parent
+        # turns into a serial fallback
+        err_repr = repr(exc)
+        conn.send(("done_error", err_repr))
+
+
+def worker_main(conn, index: int, chain_config) -> None:
+    """Long-lived worker loop. `chain_config` arrives through the fork
+    (in-memory, never pickled); everything per-block crosses the pipe."""
+    fault.child_after_fork()
+    # fork copies only the calling thread; anything still visible here is
+    # a bookkeeping ghost of a parent thread (native pools must be
+    # respawned, not inherited — the parent counts these as
+    # exec/shard/fork_guard_trips)
+    stale_threads = threading.active_count() - 1
+    while True:
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            return
+        kind = msg[0]
+        if kind == "ping":
+            conn.send(("pong", index, os.getpid(), stale_threads))
+        elif kind == "exit":
+            return
+        elif kind == "crash":
+            os._exit(CRASH_EXIT)
+        elif kind == "exec":
+            _handle_exec(conn, chain_config, msg[1])
+        else:
+            conn.send(("error", f"unknown message kind {kind!r}"))

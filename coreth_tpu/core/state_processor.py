@@ -7,13 +7,14 @@ Finalize for atomic-tx extra state (state_processor.go:68-107).
 
 from __future__ import annotations
 
+import threading
 from typing import List, Optional, Tuple
 
 from ..evm.evm import EVM, BlockContext, Config, TxContext
 from ..metrics import default_registry as _metrics
 from ..metrics.spans import span
 from ..native import keccak256
-from . import parallel_exec
+from . import exec_shards, parallel_exec
 from .state_transition import GasPool, Message, apply_message, tx_as_message
 from .types import Block, Header, Receipt, Signer
 
@@ -87,16 +88,50 @@ def apply_message_to_receipt(config, evm: EVM, gp: GasPool, statedb, header: Hea
 
 
 class StateProcessor:
-    def __init__(self, config, chain, engine, parallel_workers: int = 0):
+    def __init__(self, config, chain, engine, parallel_workers: int = 0,
+                 exec_shards_n: int = 0):
         self.config = config
         self.chain = chain
         self.engine = engine
         # evm-parallel-workers knob (0 = serial); CORETH_TPU_EVM_PARALLEL
         # overrides per-process at block time
         self.parallel_workers = parallel_workers
+        # evm-exec-shards knob (0 = in-process paths only);
+        # CORETH_TPU_EVM_EXEC_SHARDS overrides per-process at block time
+        self.exec_shards = exec_shards_n
+        # lazily forked on the first sharded block (forking at chain boot
+        # would freeze a half-built image into every worker); guarded by
+        # _shard_mu. Shared with the insert pipeline's submit stage.
+        self._shard_pool = None  # guarded-by: _shard_mu
+        self._shard_mu = threading.Lock()
         # stats of the most recent process() call, consumed by the
         # chain's flight recorder ("parallel" field)
         self.last_parallel: dict = {"mode": "serial"}
+
+    def shard_pool(self):
+        """The live shard pool, forking it on first use — or None when
+        the knob is off, the pool is demoted (lifecycle ladder), or the
+        fork itself failed (counted as a fallback; retried next block)."""
+        n = exec_shards.effective_shards(self.exec_shards)
+        if n <= 0:
+            return None
+        with self._shard_mu:
+            pool = self._shard_pool
+            if pool is not None:
+                return pool if pool.healthy else None
+            try:
+                pool = exec_shards.ShardPool(n, self.config)
+            except Exception:
+                _metrics.counter("exec/shard/fallbacks").inc()
+                return None
+            self._shard_pool = pool
+            return pool
+
+    def close(self) -> None:
+        with self._shard_mu:
+            pool, self._shard_pool = self._shard_pool, None
+        if pool is not None:
+            pool.close()
 
     def process(self, block: Block, parent: Header, statedb,
                 vm_config: Config = None) -> Tuple[list, list, int]:
@@ -115,12 +150,36 @@ class StateProcessor:
         evm = EVM(block_ctx, TxContext(), statedb, self.config, vm_config or Config())
 
         workers = parallel_exec.effective_workers(self.parallel_workers)
+        shards = exec_shards.effective_shards(self.exec_shards)
         self.last_parallel = {"mode": "serial"}
         parallel = None
-        if (workers > 0
-                and len(block.transactions) >= parallel_exec.MIN_PARALLEL_TXS
-                and (vm_config is None or vm_config.tracer is None)
-                and self.config.is_byzantium(header.number)):
+        gate_ok = (len(block.transactions) >= parallel_exec.MIN_PARALLEL_TXS
+                   and (vm_config is None or vm_config.tracer is None)
+                   and self.config.is_byzantium(header.number))
+        if shards > 0 and gate_ok:
+            # third execution mode: GIL-free process shards. Checked
+            # BEFORE the thread mode; a shard fallback goes straight to
+            # the serial loop (mixing both speculative paths on one
+            # block would double-execute for no win).
+            pool = self.shard_pool()
+            if pool is not None:
+                try:
+                    parallel, stats = exec_shards.execute_block_sharded(
+                        self.config, block, parent, statedb, block_ctx,
+                        vm_config or Config(), shards, pool,
+                    )
+                except Exception:
+                    # same contract as the thread mode: the fold is the
+                    # only StateDB mutation and it runs last, so the
+                    # serial loop below re-executes from pristine state
+                    _metrics.counter("exec/shard/fallbacks").inc()
+                    parallel, stats = None, {
+                        "mode": "serial", "workers": shards,
+                        "conflicts": 0, "reexecs": 0, "deps": 0,
+                        "fallback": True,
+                    }
+                self.last_parallel = stats
+        elif workers > 0 and gate_ok:
             try:
                 parallel, stats = parallel_exec.execute_block(
                     self.config, block, parent, statedb, block_ctx,

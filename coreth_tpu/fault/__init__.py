@@ -191,6 +191,38 @@ def is_armed(name: str) -> bool:
         return name in _armed
 
 
+def armed_spec(name: str) -> Optional[str]:
+    """The spec string [name] is currently armed with, or None. Lets a
+    site branch on the armed *verb* (exec_shards must not park its own
+    dispatch thread on a `hang` meant for a forked child)."""
+    if not enabled:
+        return None
+    with _lock:
+        a = _armed.get(name)
+        return a.spec if a is not None else None
+
+
+def _fire_counter(name: str) -> None:
+    default_registry.counter(f"fault/fired/{name}").inc()
+
+
+_fired_hook = _fire_counter
+
+
+def child_after_fork() -> None:
+    """Re-arm this module inside a forked shard worker (core/shard_worker):
+    fresh lock/event objects — the parent's copies may have been held/set
+    by a thread that does not exist after fork — and a no-op fired-counter
+    sink, so an env-inherited failpoint firing in the child never touches
+    the (invisible, copy-on-write) metrics registry. Env/fork-inherited
+    arming itself is preserved: `_armed` carries over, which is what makes
+    CORETH_TPU_FAILPOINTS drills replayable inside forked children."""
+    global _lock, _unhang, _fired_hook
+    _lock = threading.Lock()
+    _unhang = threading.Event()
+    _fired_hook = lambda name: None
+
+
 def failpoint(name: str) -> None:
     """The injection site. A single module-bool check when nothing is
     armed; otherwise fires the configured action for [name]."""
@@ -202,7 +234,7 @@ def failpoint(name: str) -> None:
             return
         verb, arg = armed.verb, armed.arg
         unhang = _unhang
-    default_registry.counter(f"fault/fired/{name}").inc()
+    _fired_hook(name)
     if verb == "raise":
         raise FailpointError(name, arg)
     if arg:  # hang:<ms>

@@ -74,6 +74,15 @@ CATALOGUE = (
     ("ops/device/dispatch", "device", "device", ("raise*4", "hang:5*4")),
     ("resident/before_absorb", "device", "insert", ("hang:5*2",)),
     ("state/resident/spot_check", "device", "spotcheck", ("raise*1",)),
+    # exec shards: before_dispatch fires in the parent (raise -> fallback
+    # before any fork traffic; hang -> bounded stall under the dispatch
+    # span). shard_crash is raise-only HERE because hang specs park the
+    # forked child, not the parent — the parent-side translation kills a
+    # real worker process, so coverage counts in the parent registry and
+    # the serial fallback must still commit the same root (invariant #1).
+    ("exec/before_dispatch", "shard", "shard",
+     ("raise*1", "raise%0.5*2", "hang:5*2")),
+    ("exec/shard_crash", "shard", "shard", ("raise*1", "raise*2")),
 )
 
 # exceptions the conductor treats as the *point* of the exercise: every
@@ -280,6 +289,7 @@ class Conductor:
                         resident_pipeline_depth=2,
                         resident_spot_check_interval=1,
                         insert_pipeline_depth=2,
+                        evm_exec_shards=2,
                         db_verify_on_read=True, db_retry_budget=2,
                         tail_join_timeout=self.step_budget / 2,
                         device_probe_interval=0.0),
@@ -623,8 +633,48 @@ class Conductor:
                           "rung still engaged after disarm")
         return faults
 
+    def _make_shard_block(self, txs: int = 4):
+        """One block with enough txs to clear the shard dispatch gate
+        (exec_shards.MIN_SHARD_TXS) — _make_blocks' 1-tx blocks never
+        reach the forked workers."""
+        from ..core.chain_makers import generate_chain
+
+        chain = self.chain
+        nonce = chain.state().get_nonce(self.addr1)
+
+        def gen(i, bg):
+            for j in range(txs):
+                bg.add_tx(self._tx(nonce + j))
+
+        blocks, _ = generate_chain(
+            chain.config, chain.current_block, chain.engine,
+            chain.state_database, 1, gap=10, gen=gen)
+        return blocks[0]
+
+    def act_shard(self) -> int:
+        """A multi-tx block through the forked execution shards. An
+        armed shard_crash SIGKILLs a real worker mid-dispatch; the pool
+        ladder respawns it and the block falls back to the untouched
+        serial loop. The committed root must be identical either way —
+        invariant #1 (pure-trie root parity) is exactly the killed-
+        shard-never-changes-the-root check, run after every step."""
+        faults = 0
+        chain = self.chain
+        crashes_before = self._counter_delta("exec/shard/crashes")
+        try:
+            chain.insert_block(self._make_shard_block())
+        except self.expected:
+            faults += 1
+        faults += self._quiesce()
+        # a worker killed by the armed failpoint surfaces as a crash
+        # counted in the parent, not as an exception out of insert
+        faults += (self._counter_delta("exec/shard/crashes")
+                   - crashes_before)
+        return faults
+
     ACTIONS = {
         "insert": act_insert,
+        "shard": act_shard,
         "spotcheck": act_spotcheck,
         "reorg": act_reorg,
         "rpc": act_rpc,
@@ -888,6 +938,12 @@ class Conductor:
                         self._counter_delta("ops/device/demotions"),
                     "mirror_quarantines":
                         self._counter_delta("chain/mirror/quarantines"),
+                    "shard_crashes":
+                        self._counter_delta("exec/shard/crashes"),
+                    "shard_respawns":
+                        self._counter_delta("exec/shard/respawns"),
+                    "shard_fallbacks":
+                        self._counter_delta("exec/shard/fallbacks"),
                 },
             }
             return result

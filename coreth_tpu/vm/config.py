@@ -91,6 +91,12 @@ class Config:
     # deterministically in tx-index order. 0 (default) keeps the serial
     # loop; the CORETH_TPU_EVM_PARALLEL env var overrides either way.
     evm_parallel_workers: int = 0
+    # GIL-free process-level execution shards (core/exec_shards): forked
+    # worker processes run speculative tx execution and ship write-sets
+    # back for the deterministic fold/validate gate. 0 (default) keeps
+    # the in-process paths; checked before evm-parallel-workers; the
+    # CORETH_TPU_EVM_EXEC_SHARDS env var overrides either way.
+    evm_exec_shards: int = 0
 
     # --- pruning ----------------------------------------------------------
     pruning_enabled: bool = True
@@ -329,6 +335,10 @@ class Config:
             raise ValueError(
                 f"evm-parallel-workers must be in [0, 64] "
                 f"(got {self.evm_parallel_workers})")
+        if not (0 <= self.evm_exec_shards <= 16):
+            raise ValueError(
+                f"evm-exec-shards must be in [0, 16] "
+                f"(got {self.evm_exec_shards})")
         if self.device_call_timeout < 0:
             raise ValueError(
                 f"device-call-timeout must be >= 0 "

@@ -234,6 +234,7 @@ class VM:
                 state_backend=full.state_backend,
                 shadow_check_interval=full.shadow_check_interval,
                 evm_parallel_workers=full.evm_parallel_workers,
+                evm_exec_shards=full.evm_exec_shards,
                 insert_slo_budget=full.chain_insert_slo_budget,
             ),
             self.chain_config,

@@ -547,6 +547,92 @@ def test_sa010_quiet_on_view_resolution():
             if f.rule == "SA010"] == []
 
 
+# ---------------------------------------------------------------- SA011
+
+_SA011_PATH = "coreth_tpu/core/shard_worker.py"
+
+_SA011_BAD = """
+import os
+from ..metrics import default_registry
+from .blockchain import BlockChain
+
+_CACHE = {}
+
+def handle(req):
+    with chain.chainmu:
+        default_registry.counter("x").inc()
+"""
+
+
+def test_sa011_fires_on_fork_unclean_worker():
+    out = [f for f in findings(_SA011_BAD, _SA011_PATH)
+           if f.rule == "SA011"]
+    # metrics import, blockchain import, two module-scope project
+    # imports, module-level dict, chainmu attr, default_registry name
+    assert len(out) >= 6
+    msgs = " ".join(f.message for f in out)
+    assert "metrics" in msgs
+    assert "chainmu" in msgs
+    assert "default_registry" in msgs
+    assert "mutable" in msgs
+
+
+def test_sa011_fires_on_lazy_metrics_import():
+    # banned packages are banned even inside functions — laziness does
+    # not make the parent's registry safe to touch from a forked child
+    src = """
+    def handle(req):
+        from ..metrics import default_registry as reg
+        reg.counter("x").inc()
+    """
+    out = [f for f in findings(src, _SA011_PATH) if f.rule == "SA011"]
+    assert len(out) == 1  # the aliased import itself is the finding
+    assert "metrics" in out[0].message
+
+
+def test_sa011_quiet_on_fork_clean_worker():
+    src = """
+    import os
+    import threading
+
+    from .. import fault
+
+    CRASH_EXIT = 13
+    NAMES = ("a", "b")
+
+    def handle(conn, req):
+        from ..core.parallel_exec import _VersionedTable
+
+        local = {}
+        err_repr = None
+        try:
+            table = _VersionedTable()
+        except Exception as exc:
+            err_repr = repr(exc)
+        conn.send(("done", err_repr))
+    """
+    assert [f for f in findings(src, _SA011_PATH)
+            if f.rule == "SA011"] == []
+
+
+def test_sa011_quiet_outside_worker_modules():
+    # the same code is fine in parent-process modules
+    for relpath in ("coreth_tpu/core/blockchain.py",
+                    "coreth_tpu/core/exec_shards.py"):
+        assert [f for f in findings(_SA011_BAD, relpath)
+                if f.rule == "SA011"] == []
+
+
+def test_sa011_real_worker_module_is_clean():
+    import pathlib
+
+    import coreth_tpu.core.shard_worker as sw
+
+    src = pathlib.Path(sw.__file__).read_text()
+    assert [f for f in findings(src, _SA011_PATH)
+            if f.rule == "SA011"] == []
+
+
 # ------------------------------------------------------------ repo gate
 
 def test_repo_is_clean_modulo_baseline():

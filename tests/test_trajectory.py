@@ -56,6 +56,26 @@ class TestIngestion:
         points, _ = load_artifacts(str(tmp_path))
         assert points[0]["provenance"] == "host_mode"
 
+    def test_config19_shard_sweep_ingests_with_honest_provenance(
+            self, tmp_path):
+        # the exec-shard A/B is a CPU-process bench: its companion line
+        # stamps host_mode + cores, so the series is tagged host_mode and
+        # the noise gate never mistakes a 1-core ~1.0x round for a
+        # device-leg regression
+        for rnd in (1, 2, 3):
+            _suite(tmp_path, rnd, 1200.0 + rnd, config=19,
+                   metric="sharded_block_insert_txs_per_sec",
+                   extra=[{"config": 19, "host_mode": True, "cores": 1,
+                           "serial_txs_per_sec": 1100.0,
+                           "shards": {"4": {"ratio_vs_serial": 1.01}}}])
+        points, skipped = load_artifacts(str(tmp_path))
+        cfg19 = [p for p in points if p["config"] == 19]
+        assert len(cfg19) == 3 and skipped == []
+        assert all(p["provenance"] == "host_mode" for p in cfg19)
+        out = build_trajectory(points, skipped)
+        key = "cfg=19|sharded_block_insert_txs_per_sec|host_mode"
+        assert out["series"][key]["n"] == 3
+
     def test_unmeasured_device_leg_is_skipped_not_a_point(self, tmp_path):
         (tmp_path / "BENCH_r02.json").write_text(json.dumps({
             "n": 2, "cmd": "python bench.py", "rc": 0, "tail": "",

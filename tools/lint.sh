@@ -7,8 +7,14 @@ cd "$(dirname "$0")/.."
 
 rc=0
 
-echo "== coreth_tpu.analysis (AST lint: SA001-SA010, baseline-gated) =="
+echo "== coreth_tpu.analysis (AST lint: SA001-SA011, baseline-gated) =="
 python -m coreth_tpu.analysis || rc=1
+
+echo
+echo "== coreth_tpu.core.exec_shards --smoke (fork/kill/respawn shard pool) =="
+# jax-less by design (the module imports no EVM machinery at module
+# scope): forks 2 workers, SIGKILLs one, asserts the respawn ladder
+python -m coreth_tpu.core.exec_shards --smoke || rc=1
 
 echo
 echo "== coreth_tpu.metrics --check (Prometheus exposition self-test) =="
