@@ -38,6 +38,12 @@ SA011 shard-worker-isolation  modules imported inside forked execution
                        `default_registry`, no module-level mutable state —
                        module scope is stdlib + coreth_tpu.fault only,
                        EVM machinery is imported lazily per request
+SA012 sharding-discipline  jitted commit entries in the mesh-sharded
+                       modules (ops/keccak_resident, coreth_tpu/parallel)
+                       must pin explicit in_shardings/out_shardings (or
+                       carry a `# sharding:` justification), and no
+                       single-argument `device_put` — implicit placement
+                       reshards chained commits across processes
 """
 
 from __future__ import annotations
@@ -1207,11 +1213,136 @@ class ShardWorkerIsolationRule(Rule):
         return iter(findings)
 
 
+# ------------------------------------------------------------------ SA012
+
+# The pjit multi-process recipe: on a mesh spanning processes, every
+# process runs the same program, and argument placement must be decided
+# by the PROGRAM (explicit in/out shardings), never re-inferred per call
+# — an inferred placement that differs between chained commits inserts a
+# resharding collective between dispatches, which is both the perf bug
+# (cross-shard traffic the per-shard absorb just removed) and, across
+# processes, a correctness hazard (each process infers from its own
+# addressable shards). The commit-path modules therefore pin shardings
+# on every jitted entry and never call single-argument device_put.
+# A `# sharding:` comment on/above the jit site documents the justified
+# exceptions (e.g. the unsharded fallback path).
+SHARDING_DISCIPLINE_PATHS = (
+    "coreth_tpu/ops/keccak_resident.py",
+    "coreth_tpu/parallel/__init__.py",
+)
+_SHARDING_KWARGS = {"in_shardings", "out_shardings"}
+_JIT_NAMES = {"jax.jit", "jit", "jax.pjit", "pjit"}
+
+
+def _as_jit_call(node: ast.Call) -> Optional[ast.Call]:
+    """The Call carrying jit options: [node] itself for `jax.jit(...)`,
+    the partial call for `functools.partial(jax.jit, ...)`; None when
+    [node] is not a jit entry."""
+    name = dotted(node.func) or ""
+    if name in _JIT_NAMES:
+        return node
+    if name.split(".")[-1] == "partial" and node.args:
+        inner = dotted(node.args[0]) or ""
+        if inner in _JIT_NAMES:
+            return node
+    return None
+
+
+class ShardingDisciplineRule(Rule):
+    """Mesh commit-path modules must declare jit placement explicitly:
+    every `jax.jit` / `functools.partial(jax.jit, ...)` entry needs
+    in_shardings AND out_shardings (a `**kwargs` splat is trusted — the
+    options were assembled elsewhere), or a `# sharding:` comment
+    justifying why placement is out of scope (unsharded fallbacks).
+    `device_put` must always carry an explicit placement argument."""
+
+    id = "SA012"
+    title = "commit-path jit/device_put without explicit sharding"
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        if src.relpath not in SHARDING_DISCIPLINE_PATHS:
+            return iter(())
+        rule = self
+        findings: List[Finding] = []
+
+        def annotated(lo: int, hi: int) -> bool:
+            # `# sharding: ...` on any line in [lo-2, hi] (same line,
+            # the two lines above, or between decorator and def)
+            return any("sharding:" in src.comments.get(ln, "")
+                       for ln in range(max(1, lo - 2), hi + 1))
+
+        def jit_missing_shardings(call: ast.Call) -> bool:
+            names = {kw.arg for kw in call.keywords}
+            if None in names:  # **splat: assembled kwargs are trusted
+                return False
+            return not _SHARDING_KWARGS.issubset(names)
+
+        handled: Set[int] = set()
+
+        class V(QualnameVisitor):
+            def _check_decorators(self, node) -> None:
+                for dec in node.decorator_list:
+                    lo = min(d.lineno for d in node.decorator_list)
+                    if isinstance(dec, ast.Call):
+                        call = _as_jit_call(dec)
+                        if call is None:
+                            continue
+                        handled.add(id(dec))
+                        if (jit_missing_shardings(call)
+                                and not annotated(lo, node.lineno)):
+                            findings.append(rule.finding(
+                                src, dec, self.qualname,
+                                f"jitted entry `{node.name}` declares no "
+                                f"in_shardings/out_shardings — pin both "
+                                f"(or justify with a `# sharding:` "
+                                f"comment): inferred placement reshards "
+                                f"chained commits across processes"))
+                    elif (dotted(dec) or "") in _JIT_NAMES:
+                        if not annotated(lo, node.lineno):
+                            findings.append(rule.finding(
+                                src, dec, self.qualname,
+                                f"bare @jit on `{node.name}` — pin "
+                                f"in_shardings/out_shardings (or justify "
+                                f"with a `# sharding:` comment)"))
+
+            def visit_FunctionDef(self, node) -> None:
+                self._check_decorators(node)
+                QualnameVisitor.visit_FunctionDef(self, node)
+
+            def visit_AsyncFunctionDef(self, node) -> None:
+                self._check_decorators(node)
+                QualnameVisitor.visit_AsyncFunctionDef(self, node)
+
+            def visit_Call(self, node: ast.Call) -> None:
+                name = dotted(node.func) or ""
+                if id(node) not in handled:
+                    call = _as_jit_call(node)
+                    if (call is not None and jit_missing_shardings(call)
+                            and not annotated(node.lineno, node.lineno)):
+                        findings.append(rule.finding(
+                            src, node, self.qualname,
+                            "jit call without in_shardings/out_shardings "
+                            "— pin both (or justify with a `# sharding:` "
+                            "comment)"))
+                    if (name.split(".")[-1] == "device_put"
+                            and len(node.args) < 2 and not node.keywords
+                            and not annotated(node.lineno, node.lineno)):
+                        findings.append(rule.finding(
+                            src, node, self.qualname,
+                            "single-argument device_put on the commit "
+                            "path — implicit placement reshards; pass an "
+                            "explicit Sharding (replicated for uploads)"))
+                self.generic_visit(node)
+
+        V().visit(src.tree)
+        return iter(findings)
+
+
 ALL_RULES: Tuple[type, ...] = (
     SilentExceptRule, LockDisciplineRule, HotPathPurityRule,
     ConsensusFloatRule, UnorderedIterationRule, FailpointHygieneRule,
     ServingBoundednessRule, BackendIsolationRule, FoldOrderRule,
-    ReadTierLockRule, ShardWorkerIsolationRule,
+    ReadTierLockRule, ShardWorkerIsolationRule, ShardingDisciplineRule,
 )
 
 

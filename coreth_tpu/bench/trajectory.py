@@ -78,9 +78,17 @@ def _direction(metric: str, unit: Optional[str]) -> Optional[str]:
     """"higher" / "lower" is better, None when the metric is unjudgeable."""
     u = (unit or "").lower()
     m = metric.lower()
+    if "modeled" in m:
+        # an analytic model, not a measurement: the sentinel reports it
+        # but never gates on it (the provenance-split contract)
+        return None
     if "per_sec" in m or "/s" in u:
         return "higher"
     if m.endswith(("_s", "_ms", "_seconds")) or u in ("s", "ms", "seconds"):
+        return "lower"
+    if u.startswith("b/") or u in ("bytes", "mb") or "bytes_per" in m:
+        # wire/disk footprint series (config-20 bytes-per-commit A/B):
+        # fewer bytes moved is the win
         return "lower"
     return None
 

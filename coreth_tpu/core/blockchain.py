@@ -186,6 +186,12 @@ class CacheConfig:
     # demotes mesh -> single-device resident -> host, each rung
     # bit-exact
     resident_mesh_devices: int = 0
+    # storage-lean node rows (SonicDB-style fixed-width records): fresh
+    # single-block nodes upload as 72-byte content-only records (+ 4 B
+    # arena index + 4 B length = 80 B/leaf on the wire vs the 136-byte
+    # padded row); the device re-derives the keccak padding. Root-exact
+    # on every path; OFF by default until config-20 A/B data accumulates
+    resident_lean_rows: bool = False
     # deadline (seconds) for join_tail / acceptor-queue joins; on expiry
     # they raise TailStalled instead of blocking forever. 0 = unbounded
     tail_join_timeout: float = 0.0
@@ -230,6 +236,8 @@ _FLIGHT_COUNTERS = (
     "state/snap/hits", "state/snap/misses", "state/snap/generating",
     "resident/plan_cache/hits", "resident/plan_cache/misses",
     "resident/h2d_bytes", "resident/gather_bytes",
+    "resident/gather_bytes_modeled", "resident/absorb_d2h_bytes",
+    "resident/lean_wire_bytes",
     "trie/keccak/batches", "trie/keccak/batch_msgs",
 )
 _FLIGHT_TIMERS = (
@@ -822,6 +830,7 @@ class BlockChain:
             template_residency=(
                 self.cache_config.resident_template_residency),
             mesh_devices=self.cache_config.resident_mesh_devices,
+            lean_rows=self.cache_config.resident_lean_rows,
         )
         self.mirror.on_takeover = self._on_mirror_takeover
         self.state_database.mirror = self.mirror

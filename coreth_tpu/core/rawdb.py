@@ -67,6 +67,16 @@ SYNC_STORAGE_TRIES_PREFIX = b"sync_storage"
 SYNC_SEGMENTS_PREFIX = b"sync_segments"
 CODE_TO_FETCH_PREFIX = b"CP"
 
+# storage-lean trie-node rows (PR 18, SonicDB-style): nodes addressed by
+# their resident digest-store SLOT instead of the 32-byte content hash —
+# N + slot(4) -> digest(32) + node RLP. The 5-byte key replaces a
+# 32-byte one and the digest rides in the value, so lookups by slot need
+# no hash and the verify-on-read contract still holds (the stored digest
+# re-checks against keccak(rlp)). This is the disk image of the lean
+# wire format behind the template-residency seam; the consensus path
+# stays hash-addressed (sibling/orphan GC relies on content addressing).
+LEAN_NODE_PREFIX = b"N"
+
 
 def _num(n: int) -> bytes:
     return n.to_bytes(8, "big")
@@ -180,6 +190,44 @@ def write_head_header_hash(db, block_hash: bytes) -> None:
     db.put(HEAD_HEADER_KEY, block_hash)
 
 
+# --- storage-lean node rows (digest-slot-addressed, PR 18) ------------------
+
+def lean_node_key(slot: int) -> bytes:
+    return LEAN_NODE_PREFIX + slot.to_bytes(4, "big")
+
+
+def write_lean_node(db, slot: int, digest: bytes, rlp: bytes) -> None:
+    if len(digest) != 32:
+        raise ValueError("lean node digest must be 32 bytes")
+    db.put(lean_node_key(slot), digest + rlp)
+
+
+def read_lean_node(db: KeyValueStore, slot: int):
+    """(digest, rlp) at [slot], or None. verify_on_read re-hashes the
+    RLP against the stored digest — slot keys carry no hash, so the
+    digest in the value is what anchors the integrity check."""
+    v = db.get(lean_node_key(slot))
+    if v is None:
+        return None
+    digest, rlp = v[:32], v[32:]
+    if verify_on_read:
+        _verify(rlp, digest, "lean trie node")
+    return digest, rlp
+
+
+def lean_nodes_footprint(db: KeyValueStore) -> dict:
+    """{count, bytes} of the lean node-row keyspace (key + value bytes)
+    — the config-20 disk-footprint A/B reads this instead of a full
+    inspect_database walk."""
+    count = 0
+    size = 0
+    for k, v in db.iterate():
+        if k.startswith(LEAN_NODE_PREFIX) and len(k) == 5:
+            count += 1
+            size += len(k) + len(v)
+    return {"count": count, "bytes": size}
+
+
 # --- tx lookup --------------------------------------------------------------
 
 def read_tx_lookup(db, tx_hash: bytes) -> Optional[int]:
@@ -206,6 +254,7 @@ def inspect_database(db) -> dict:
         ("accountSnapshot", SNAPSHOT_ACCOUNT_PREFIX, 33),
         ("storageSnapshot", SNAPSHOT_STORAGE_PREFIX, 65),
         ("bloomBits", b"B", 7),
+        ("leanTrieNodes", LEAN_NODE_PREFIX, 5),  # N + slot(4)
         ("syncProgress", b"sync_", 0),
     ]
     stats = {name: {"count": 0, "bytes": 0} for name, _, _ in categories}

@@ -360,6 +360,18 @@ def load_inc():
         lib.mpt_inc_res_mark_clean.argtypes = [ctypes.c_void_p]
         lib.mpt_inc_res_absorb.restype = None
         lib.mpt_inc_res_absorb.argtypes = [ctypes.c_void_p, _u8p, _u8p]
+        lib.mpt_inc_res_absorb_lanes.restype = ctypes.c_int64
+        lib.mpt_inc_res_absorb_lanes.argtypes = [
+            ctypes.c_void_p, _i32p, _u8p, ctypes.c_int64,
+        ]
+        lib.mpt_inc_res_absorb_finish.restype = ctypes.c_int64
+        lib.mpt_inc_res_absorb_finish.argtypes = [ctypes.c_void_p, _u8p]
+        lib.mpt_inc_set_lean.restype = None
+        lib.mpt_inc_set_lean.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.mpt_inc_res_lean_count.restype = ctypes.c_int64
+        lib.mpt_inc_res_lean_count.argtypes = [ctypes.c_void_p]
+        lib.mpt_inc_res_lean.restype = None
+        lib.mpt_inc_res_lean.argtypes = [ctypes.c_void_p, _u8p, _i32p, _i32p]
         lib.mpt_inc_mark_all_dirty.restype = None
         lib.mpt_inc_mark_all_dirty.argtypes = [ctypes.c_void_p]
         lib.mpt_inc_res_reset.restype = None
@@ -381,6 +393,10 @@ def load_inc():
         lib.mpt_inc_absorb_store.restype = None
         lib.mpt_inc_absorb_store.argtypes = [
             ctypes.c_void_p, _u8p, ctypes.c_int64,
+        ]
+        lib.mpt_inc_absorb_store_range.restype = None
+        lib.mpt_inc_absorb_store_range.argtypes = [
+            ctypes.c_void_p, _u8p, ctypes.c_int64, ctypes.c_int64,
         ]
         lib.mpt_inc_export_size.restype = ctypes.c_int64
         lib.mpt_inc_export_size.argtypes = [
@@ -440,6 +456,12 @@ def _run_with_watchdog(fn, timeout: float, what: str):
 EMPTY_ROOT = bytes.fromhex(
     "56e81f171bcc55a6ff8345e692c0f86e5b48e01b996cadc001622fb5e363b421"
 )
+
+# Lean wire record width (native kLeanWidth): a fresh class-1 row whose
+# RLP fits this many bytes ships content-only — the device re-derives
+# the keccak pad bits — so a leaf costs 72 B of row payload + 4 B arena
+# index + 4 B length on the wire instead of the 136 B padded row.
+LEAN_ROW_WIDTH = 72
 
 
 class IncrementalTrie:
@@ -612,10 +634,20 @@ class IncrementalTrie:
                 fresh[cls] = (rows.view(np.uint32).reshape(n_fresh,
                                                            width // 4),
                               idx)
+            lean = None
+            n_lean = int(lib.mpt_inc_res_lean_count(h))
+            if n_lean:
+                lrows = np.empty(n_lean * LEAN_ROW_WIDTH, np.uint8)
+                lidx = np.empty(n_lean, np.int32)
+                llen = np.empty(n_lean, np.int32)
+                lib.mpt_inc_res_lean(h, lrows, lidx, llen)
+                lean = (lrows.view(np.uint32).reshape(
+                    n_lean, LEAN_ROW_WIDTH // 4), lidx, llen)
         return {
             "specs": specs,
             "classes": classes,
             "fresh": fresh,
+            "lean": lean,
             "rowidx": rowidx,
             "lane_slot": lane_slot,
             "off": off,
@@ -752,7 +784,8 @@ class IncrementalTrie:
 
         return resolve
 
-    def commit_template(self, executor, timeout: Optional[float] = None):
+    def commit_template(self, executor, timeout: Optional[float] = None,
+                        full_readback: bool = False):
         """Template-resident planned commit: the device keeps this trie's
         row arenas + digest store across commits (dirty BRANCH rows are
         re-zeroed/re-patched on device, uploads carry only fresh content
@@ -777,6 +810,46 @@ class IncrementalTrie:
         if export is None:
             return self.root()
 
+        if getattr(executor, "shards", 1) > 1 and not full_readback:
+            # per-shard absorb (mesh steady state): each shard's digests
+            # come home straight from that shard's store partition —
+            # shard-local gathers + d2h of exactly this commit's lanes,
+            # never a host materialization of the replicated dig matrix.
+            # full_readback=True keeps the all-gather path reachable for
+            # the parity oracle (tests A/B the two absorbs bit-exactly).
+            def sync():
+                executor.run(export)
+                failpoint("resident/before_absorb")
+                return executor.shard_digests(export)
+
+            if timeout is None:
+                parts = sync()
+            else:
+                parts = _run_with_watchdog(sync, timeout, "template commit")
+            out = np.empty(32, np.uint8)
+            with phase_timer("resident/phase/absorb"):
+                for lanes_k, digs_k in parts:
+                    if lanes_k.shape[0] == 0:
+                        continue
+                    self._lib.mpt_inc_res_absorb_lanes(
+                        self._h,
+                        np.ascontiguousarray(lanes_k, np.int32),
+                        np.ascontiguousarray(digs_k).view(
+                            np.uint8).reshape(-1),
+                        lanes_k.shape[0])
+                missed = int(self._lib.mpt_inc_res_absorb_finish(
+                    self._h, out))
+            if missed:
+                # unabsorbed lanes stay dirty (the next plan re-hashes
+                # them), so the cache is never stale — but a partial
+                # absorb here means the shard split itself is wrong
+                raise RuntimeError(
+                    f"per-shard absorb missed {missed} lane(s): shard "
+                    "partition does not cover the commit's store slots")
+            if int(export["root_lane"]) < 0:
+                return self.root()  # root not among this plan's lanes
+            return out.tobytes()
+
         def sync():
             executor.run(export)
             failpoint("resident/before_absorb")
@@ -786,6 +859,10 @@ class IncrementalTrie:
             dig = sync()
         else:
             dig = _run_with_watchdog(sync, timeout, "template commit")
+        if getattr(executor, "shards", 1) > 1:
+            # the full replicated dig matrix just materialized host-side:
+            # THE measured cross-shard digest gather (parity/test path)
+            executor.note_dig_gather(export)
         # strip the zero-sentinel row: the native absorb expects global
         # lane order exactly like the planned path's digest matrix
         dig8 = np.ascontiguousarray(dig[1:]).view(np.uint8).reshape(-1)
@@ -853,6 +930,25 @@ class IncrementalTrie:
         arr = np.ascontiguousarray(np.asarray(store)).view(np.uint8)
         n_slots = arr.size // 32
         self._lib.mpt_inc_absorb_store(self._h, arr.reshape(-1), n_slots)
+
+    def absorb_store_parts(self, parts) -> None:
+        """Sharded variant of absorb_store: absorb per-shard contiguous
+        store partitions [(slot_lo, slot_hi, uint32[rows, 8]), ...] as
+        read back shard-locally by executor.store_parts() — the whole
+        device store reaches the host cache without ever reassembling
+        the full replicated matrix host-side."""
+        for lo, hi, part in parts:
+            arr = np.ascontiguousarray(np.asarray(part)).view(np.uint8)
+            self._lib.mpt_inc_absorb_store_range(
+                self._h, arr.reshape(-1), int(lo), int(hi))
+
+    def set_lean(self, on: bool) -> None:
+        """Enable the storage-lean wire format: fresh class-1 rows whose
+        RLP fits LEAN_ROW_WIDTH bytes ship as content-only records (the
+        device re-derives keccak padding). Safe to flip between commits;
+        it only changes how fresh rows travel, never what the arena or
+        the host cache hold."""
+        self._lib.mpt_inc_set_lean(self._h, 1 if on else 0)
 
     def export_nodes(self, delta: bool = False):
         """Export hashed nodes as (digests uint8[N, 32], rlp bytes,

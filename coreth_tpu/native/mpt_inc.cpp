@@ -116,6 +116,13 @@ struct ResSeg {
 };
 
 constexpr int kMaxBlocks = 64;  // widest supported node row (8.7 KB RLP)
+// Storage-lean wire format (SonicDB S6 shape): a fresh class-1 row whose
+// RLP fits kLeanWidth bytes ships as a fixed-width content-only record —
+// the device re-derives the keccak pad bits from the shipped length, so
+// the wire carries 72 B of content + 4 B row index + 4 B length instead
+// of the 136 B padded row. 72 covers every account/storage leaf shape
+// (slim account RLP <= 70 B, storage slot leaf <= 69 B).
+constexpr int kLeanWidth = 72;
 
 struct Inc {
   INode* root = nullptr;
@@ -133,8 +140,14 @@ struct Inc {
     std::vector<int32_t> free_rows;
     std::vector<uint8_t> fresh_rows;  // packed row bytes to upload
     std::vector<int32_t> fresh_idx;   // target arena rows
+    // lean (content-only, kLeanWidth-byte) upload records, class 1 only:
+    // the device zero-extends each record to a full padded row
+    std::vector<uint8_t> lean_rows;
+    std::vector<int32_t> lean_idx;
+    std::vector<int32_t> lean_len;
   };
   std::vector<ResCls> rcls = std::vector<ResCls>(kMaxBlocks + 1);
+  bool lean = false;  // lean wire format enabled (mpt_inc_set_lean)
   std::vector<ResSeg> rsegs;
   std::vector<int32_t> r_rowidx, r_lane_slot;
   // patch tables: byte offset in the arena (device derives word+shift),
@@ -728,6 +741,9 @@ int build_plan_res(Inc& t) {
   for (auto& c : t.rcls) {
     c.fresh_rows.clear();
     c.fresh_idx.clear();
+    c.lean_rows.clear();
+    c.lean_idx.clear();
+    c.lean_len.clear();
   }
   t.r_rowidx.clear();
   t.r_lane_slot.clear();
@@ -840,7 +856,35 @@ int build_plan_res(Inc& t) {
       bool upload = seg.fresh_of_lane[lane] != 0;
       patches.clear();
       uint8_t* row;
-      if (upload) {
+      if (upload && t.lean && seg.blocks == 1) {
+        // lean wire format: render into scratch, ship the content-only
+        // record when it fits; the device re-derives both keccak pad
+        // bits (0x01 at len, 0x80 at byte 135) while zero-extending
+        auto& cls = t.rcls[seg.blocks];
+        row = scratch.data();
+        RowWriter<ResPolicy> w{{patches}, row};
+        uint8_t* out = row;
+        w.write_node(n, out);
+        int len = (int)(out - row);
+        if (len <= kLeanWidth) {
+          size_t base = cls.lean_rows.size();
+          cls.lean_rows.resize(base + kLeanWidth, 0);
+          std::memcpy(cls.lean_rows.data() + base, row, len);
+          cls.lean_idx.push_back(n->row);
+          cls.lean_len.push_back(len);
+          t.r_fresh_bytes += kLeanWidth;
+        } else {  // class-1 but wider than the lean record: full row
+          size_t base = cls.fresh_rows.size();
+          cls.fresh_rows.resize(base + width);
+          uint8_t* frow = cls.fresh_rows.data() + base;
+          std::memcpy(frow, row, len);
+          std::memset(frow + len, 0, width - len);
+          frow[len] ^= 0x01;  // keccak pad
+          frow[width - 1] ^= 0x80;
+          cls.fresh_idx.push_back(n->row);
+          t.r_fresh_bytes += width;
+        }
+      } else if (upload) {
         auto& cls = t.rcls[seg.blocks];
         size_t base = cls.fresh_rows.size();
         cls.fresh_rows.resize(base + width);
@@ -924,6 +968,27 @@ void res_absorb_digests(Inc& t, const uint8_t* dig) {
     n->structural = false;
   }
   t.r_embedded_dirty.clear();
+}
+
+// Resolve a global resident-plan lane to its node (nullptr for pad
+// lanes). Segments are gstart-ordered, so a binary search keeps the
+// per-shard absorb O(lanes log segs).
+INode* res_node_at_lane(Inc& t, int32_t lane) {
+  size_t lo = 0, hi = t.rsegs.size();
+  while (lo < hi) {
+    size_t mid = (lo + hi) / 2;
+    const ResSeg& seg = t.rsegs[mid];
+    if (lane < seg.gstart) {
+      hi = mid;
+    } else if (lane >= seg.gstart + seg.lanes) {
+      lo = mid + 1;
+    } else {
+      size_t local = (size_t)(lane - seg.gstart);
+      return local < seg.node_of_lane.size() ? seg.node_of_lane[local]
+                                             : nullptr;
+    }
+  }
+  return nullptr;
 }
 
 void absorb_digests(Inc& t, const uint8_t* dig) {
@@ -1218,6 +1283,29 @@ void mpt_inc_res_fresh(void* h, int32_t cls, uint8_t* rows, int32_t* idx) {
     std::memcpy(idx, c.fresh_idx.data(), c.fresh_idx.size() * 4);
 }
 
+// Lean wire format (storage-lean node rows). Enabled per trie BEFORE
+// the first resident plan; flipping it mid-residency is safe (it only
+// changes how FRESH class-1 rows travel, never what the arena holds).
+void mpt_inc_set_lean(void* h, int32_t on) { ((Inc*)h)->lean = on != 0; }
+
+// Lean class-1 records of the current plan: count, then the packed
+// kLeanWidth-byte content records with their arena rows and RLP
+// lengths (the device derives keccak padding from the length).
+int64_t mpt_inc_res_lean_count(void* h) {
+  return (int64_t)((Inc*)h)->rcls[1].lean_idx.size();
+}
+
+void mpt_inc_res_lean(void* h, uint8_t* rows, int32_t* idx, int32_t* lens) {
+  Inc* t = (Inc*)h;
+  auto& c = t->rcls[1];
+  if (!c.lean_rows.empty())
+    std::memcpy(rows, c.lean_rows.data(), c.lean_rows.size());
+  if (!c.lean_idx.empty()) {
+    std::memcpy(idx, c.lean_idx.data(), c.lean_idx.size() * 4);
+    std::memcpy(lens, c.lean_len.data(), c.lean_len.size() * 4);
+  }
+}
+
 void mpt_inc_res_tables(void* h, int32_t* rowidx, int32_t* lane_slot,
                         int32_t* off, int32_t* src, int32_t* oldidx) {
   Inc* t = (Inc*)h;
@@ -1245,6 +1333,56 @@ void mpt_inc_res_absorb(void* h, const uint8_t* dig, uint8_t* out_root32) {
   if (t->r_root_lane >= 0)
     std::memcpy(out_root32, dig + (int64_t)t->r_root_lane * 32, 32);
   res_absorb_digests(*t, dig);
+}
+
+// Per-shard template absorb (mesh commits): absorb n digests addressed
+// by GLOBAL lane index — dig[i] belongs to lanes[i] — so each mesh
+// shard's digest partition lands in the host cache straight from that
+// shard's store readback, with no replicated-dig all-gather. Pad lanes
+// and lanes already absorbed this commit (lane reset to -1) are
+// skipped. Unlike mpt_inc_res_absorb this does NOT fold the
+// mark-clean: flags stay set until mpt_inc_res_absorb_finish confirms
+// every lane arrived. Returns the number of digests absorbed.
+int64_t mpt_inc_res_absorb_lanes(void* h, const int32_t* lanes,
+                                 const uint8_t* dig, int64_t n) {
+  Inc* t = (Inc*)h;
+  int64_t absorbed = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    INode* node = res_node_at_lane(*t, lanes[i]);
+    if (!node || node->lane != lanes[i]) continue;
+    std::memcpy(node->digest, dig + i * 32, 32);
+    node->dirty = false;
+    node->unexported = true;
+    node->structural = false;
+    node->lane = -1;
+    ++absorbed;
+  }
+  return absorbed;
+}
+
+// Close a per-shard absorb: returns the number of plan lanes whose
+// digest never arrived (those nodes stay dirty, so the next plan
+// re-hashes them — a partial absorb can never serve a stale cache).
+// Only on a COMPLETE absorb (return 0) are the embedded-dirty flags
+// cleared and the root digest written to out_root32 (when the root was
+// among this plan's lanes) — the same contract mpt_inc_res_absorb
+// fulfils in one shot for the full-readback path.
+int64_t mpt_inc_res_absorb_finish(void* h, uint8_t* out_root32) {
+  Inc* t = (Inc*)h;
+  int64_t missed = 0;
+  for (auto& seg : t->rsegs)
+    for (INode* n : seg.node_of_lane)
+      if (n->lane >= 0) ++missed;
+  if (missed) return missed;
+  for (INode* n : t->r_embedded_dirty) {
+    n->dirty = false;
+    n->unexported = true;
+    n->structural = false;
+  }
+  t->r_embedded_dirty.clear();
+  if (t->r_root_lane >= 0 && t->root)
+    std::memcpy(out_root32, t->root->digest, 32);
+  return 0;
 }
 
 // Mesh-ladder demotion seam: abandon EVERY device-side assignment (store
@@ -1275,6 +1413,9 @@ void mpt_inc_res_reset(void* h) {
     c.free_rows.clear();
     c.fresh_rows.clear();
     c.fresh_idx.clear();
+    c.lean_rows.clear();
+    c.lean_idx.clear();
+    c.lean_len.clear();
   }
 }
 
@@ -1347,6 +1488,20 @@ void mpt_inc_absorb_store(void* h, const uint8_t* store, int64_t n_slots) {
   walk_all(t->root, [&](INode* n) {
     if (n->slot >= 2 && n->slot < n_slots)
       std::memcpy(n->digest, store + (int64_t)n->slot * 32, 32);
+  });
+}
+
+// Sharded variant of mpt_inc_absorb_store: absorb one CONTIGUOUS store
+// partition [slot_lo, slot_hi) read back from a single mesh shard —
+// part[0] is slot slot_lo's digest. Calling it once per shard pulls
+// the whole device store into the host cache from shard-local d2h
+// readbacks, with no host-side reassembly of the full store.
+void mpt_inc_absorb_store_range(void* h, const uint8_t* part,
+                                int64_t slot_lo, int64_t slot_hi) {
+  Inc* t = (Inc*)h;
+  walk_all(t->root, [&](INode* n) {
+    if (n->slot >= 2 && n->slot >= slot_lo && n->slot < slot_hi)
+      std::memcpy(n->digest, part + (int64_t)(n->slot - slot_lo) * 32, 32);
   });
 }
 

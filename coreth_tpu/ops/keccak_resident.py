@@ -78,12 +78,16 @@ def _strips(d: jax.Array, shift: jax.Array) -> jax.Array:
     return lo | hi
 
 
+# sharding: unsharded fallback only (non-fused run()); mesh commits go
+# through the fused program, whose in/out shardings are pinned explicitly
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _scatter_rows(arena, rows, idx):
     """Upload fresh rows into their persistent arena slots."""
     return arena.at[idx].set(rows, mode="drop")
 
 
+# sharding: unsharded fallback only (non-fused run()); mesh commits go
+# through the fused program, whose in/out shardings are pinned explicitly
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _scatter_store(store, dig, lane_slot):
     """Persist this commit's digests at their slots (pads target the
@@ -91,11 +95,16 @@ def _scatter_store(store, dig, lane_slot):
     return store.at[lane_slot].set(dig[1:], mode="drop")
 
 
+LEAN_WORDS = 18  # 72-byte lean record = 18 uint32 words (native kLeanWidth)
+
+
 def _make_res_step(seg_impl, donate: bool = True):
     """Jitted per-segment step: delta-patch the arena, gather the
     segment's rows, hash, write digests into dig. Static args are shapes
     only; per-segment offsets travel in the meta row selected by seg_i."""
 
+    # sharding: unsharded fallback only (non-fused run()); mesh commits
+    # go through the fused program's explicitly pinned in/out shardings
     @functools.partial(
         jax.jit,
         static_argnames=("lanes", "blocks", "npatch"),
@@ -157,6 +166,17 @@ class ResidentExecutor:
         # mesh builds this; dig stays replicated, it is per-commit-sized)
         self.sharding = sharding
         self._row_mult = sharding.mesh.size if sharding is not None else 1
+        # explicit upload placement: per-commit payloads (rows/aux/patch
+        # tables) are replicated over the mesh while the resident state
+        # stays row-sharded — pinning it here (instead of letting
+        # device_put infer) is what keeps chained commits reshard-free
+        # across processes (SA012 sharding discipline)
+        if sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._repl = NamedSharding(sharding.mesh, PartitionSpec())
+        else:
+            self._repl = None
         # fused = ONE dispatch + TWO uploads per commit (VERDICT r4 #3);
         # programs are keyed on the commit's static shape signature, which
         # lane/row bucketing keeps stable in steady state
@@ -188,10 +208,22 @@ class ResidentExecutor:
         self.last_dispatches = 0
         self.last_cache_hit = False
         # mesh diagnostics, explicitly zeroed when unsharded so flight-
-        # record keys stay un-ragged: modeled cross-shard digest-gather
-        # bytes of the last commit, and its lanes per store shard
+        # record keys stay un-ragged. Provenance split (PR 18):
+        # last_gather_bytes is MEASURED — bytes of replicated digest
+        # matrix actually materialized host-side (0 on the per-shard
+        # absorb path); last_gather_bytes_modeled is the (n-1)/n
+        # all-gather MODEL recorded every sharded commit for the A/B;
+        # last_absorb_d2h_bytes counts the shard-local digest readbacks
+        # that replace the gather. The trajectory sentinel only ever
+        # gates on the measured counters.
         self.last_gather_bytes = 0
+        self.last_gather_bytes_modeled = 0
+        self.last_absorb_d2h_bytes = 0
         self.last_shard_lanes: list = []
+        # lean wire diagnostics: content-only class-1 records in the
+        # last commit and their wire bytes (72 content + 4 idx + 4 len)
+        self.last_lean_rows = 0
+        self.last_lean_wire_bytes = 0
         # full digest matrix of the last run (lazy, includes the zero-
         # sentinel row 0) — template residency absorbs it host-side
         self.last_dig: Optional[jax.Array] = None
@@ -201,34 +233,135 @@ class ResidentExecutor:
         """Mesh shards holding the resident state (1 = unsharded)."""
         return self._row_mult
 
+    @property
+    def spans_processes(self) -> bool:
+        """True when the mesh's devices belong to more than one jax
+        process — the demotion ladder's local single-device rung is
+        unavailable then (a unilateral local rebuild would desync the
+        SPMD program on every other process)."""
+        if self.sharding is None:
+            return False
+        return len({d.process_index
+                    for d in self.sharding.mesh.devices.flat}) > 1
+
     def _pin(self, arr: jax.Array) -> jax.Array:
         if self.sharding is None:
             return arr
         return jax.device_put(arr, self.sharding)
 
+    def _put(self, arr):
+        """Host->device upload with an EXPLICIT placement: replicated
+        over the mesh when sharded (uploads are per-commit-sized; the
+        resident state itself stays row-sharded), default placement
+        when unsharded (None)."""
+        return jax.device_put(arr, self._repl)
+
     def _note_collectives(self, export) -> None:
-        """Per-commit collective accounting for the flight record. The
-        mesh's only cross-shard traffic is the digest all-gather back to
-        the replicated dig matrix (store/arena scatters stay shard-local
-        by row layout), modeled as (shards-1)/shards of every lane's
-        32-byte digest. lanes-per-shard comes from each lane's store
-        slot, whose contiguous row blocks are what NamedSharding
-        partitions. Unsharded commits record the explicit zeros so
-        flight-record keys stay un-ragged across configs."""
+        """Per-commit collective accounting for the flight record,
+        split by provenance (PR 18). resident/gather_bytes_modeled
+        records the (shards-1)/shards digest all-gather MODEL every
+        sharded commit — what materializing the replicated dig matrix
+        host-side would move. The MEASURED twin resident/gather_bytes
+        is reset to 0 here and only incremented by note_dig_gather when
+        a full dig readback actually happens; steady-state per-shard-
+        absorb commits therefore record 0 measured gather bytes.
+        lanes-per-shard comes from each lane's store slot, whose
+        contiguous row blocks are what NamedSharding partitions.
+        Unsharded commits record explicit zeros so flight-record keys
+        stay un-ragged across configs."""
         from ..metrics import default_registry
 
         total_lanes = int(export["total_lanes"])
         n = self._row_mult
+        self.last_gather_bytes = 0
+        self.last_absorb_d2h_bytes = 0
         if n > 1:
-            self.last_gather_bytes = total_lanes * 32 * (n - 1) // n
+            self.last_gather_bytes_modeled = total_lanes * 32 * (n - 1) // n
             per = max(1, self.store.shape[0] // n)
             owner = np.minimum(export["lane_slot"] // per, n - 1)
             self.last_shard_lanes = np.bincount(owner, minlength=n).tolist()
         else:
-            self.last_gather_bytes = 0
+            self.last_gather_bytes_modeled = 0
             self.last_shard_lanes = [total_lanes]
+        default_registry.counter("resident/gather_bytes_modeled").inc(
+            self.last_gather_bytes_modeled)
+
+    def note_dig_gather(self, export) -> None:
+        """A full replicated dig matrix materialized host-side (the
+        template full-readback path): count the MEASURED cross-shard
+        gather — (shards-1)/shards of every lane's 32-byte digest had
+        to cross shards to assemble the replica being read."""
+        from ..metrics import default_registry
+
+        n = self._row_mult
+        if n <= 1:
+            return
+        self.last_gather_bytes = int(export["total_lanes"]) * 32 \
+            * (n - 1) // n
         default_registry.counter("resident/gather_bytes").inc(
             self.last_gather_bytes)
+
+    def shard_digests(self, export):
+        """Per-shard digest readback for the mesh absorb: for each
+        store shard, gather this commit's digest rows ON that shard
+        (the store scatter already placed them — lane_slot partitions
+        by owner) and read back exactly those lanes' digests. Returns
+        [(global_lane_idx int32[k], digests uint32[k, 8]), ...] for
+        IncrementalTrie's mpt_inc_res_absorb_lanes. No replicated-dig
+        materialization, no cross-shard traffic; the d2h total lands in
+        resident/absorb_d2h_bytes (measured)."""
+        from ..metrics import default_registry
+
+        lane_slot = np.asarray(export["lane_slot"])
+        lanes_all = np.arange(lane_slot.shape[0], dtype=np.int32)
+        real = lane_slot >= 2  # pad lanes target the scratch slot 1
+        n = self._row_mult
+        per = max(1, self.store.shape[0] // n)
+        owner = np.minimum(lane_slot // per, n - 1)
+        shards = sorted(self.store.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        parts = []
+        d2h = 0
+        for k, sh in enumerate(shards):
+            sel = real & (owner == k)
+            lanes_k = lanes_all[sel]
+            if lanes_k.size == 0:
+                parts.append((lanes_k, np.zeros((0, 8), np.uint32)))
+                continue
+            local = (lane_slot[sel] - k * per).astype(np.int32)
+            digs = np.asarray(sh.data[local])  # shard-local gather+d2h
+            parts.append((lanes_k, digs))
+            d2h += lanes_k.size * 32
+        self.last_absorb_d2h_bytes = d2h
+        default_registry.counter("resident/absorb_d2h_bytes").inc(d2h)
+        return parts
+
+    def store_parts(self):
+        """Shard-local store readbacks for the interval absorb:
+        [(slot_lo, slot_hi, uint32[rows, 8]), ...] covering the whole
+        store, one entry per shard (one entry total when unsharded).
+        Pairs with IncrementalTrie.absorb_store_parts — the sharded
+        replacement for reading the full store back in one host-side
+        gather. Counted under resident/absorb_d2h_bytes (measured)."""
+        from ..metrics import default_registry
+
+        if self.store is None:
+            return []
+        if self._row_mult == 1:
+            part = np.asarray(self.store)
+            default_registry.counter("resident/absorb_d2h_bytes").inc(
+                part.nbytes)
+            return [(0, int(self.store.shape[0]), part)]
+        parts = []
+        d2h = 0
+        for sh in sorted(self.store.addressable_shards,
+                         key=lambda s: s.index[0].start or 0):
+            data = np.asarray(sh.data)
+            lo = int(sh.index[0].start or 0)
+            parts.append((lo, lo + data.shape[0], data))
+            d2h += data.nbytes
+        default_registry.counter("resident/absorb_d2h_bytes").inc(d2h)
+        return parts
 
     # ---- ownership: slot/row numbering is per-trie, so a second trie
     # sharing this executor would silently corrupt both stores ----
@@ -299,7 +432,7 @@ class ResidentExecutor:
             self._fused_cache.pop(oldest)
             self._staging.pop(oldest, None)
         (specs_t, fresh_t, classes, _store_cap, _arena_caps,
-         g_pad, len_off, len_rowidx) = key
+         g_pad, len_off, len_rowidx, lean_bucket) = key
         impl = self._impl
         narena = len(classes)
         cls_pos = {c: i for i, c in enumerate(classes)}
@@ -336,6 +469,26 @@ class ResidentExecutor:
                 rows = rows.reshape(n_rows, width); rp += n_rows * width
                 idx = aux[p:p + n_rows]; p += n_rows
                 arenas[ai] = arenas[ai].at[idx].set(rows, mode="drop")
+            if lean_bucket:
+                # lean wire records: zero-extend each 18-word content
+                # record to a full 34-word class-1 row and re-derive the
+                # keccak pad bits from the shipped RLP length (0x01 at
+                # byte len, 0x80 at byte 135). Fresh rows carry zero
+                # holes, so set == what the full upload would have held;
+                # pad records (idx 0, len 0) land in the scratch row.
+                lidx = aux[p:p + lean_bucket]; p += lean_bucket
+                llen = aux[p:p + lean_bucket]; p += lean_bucket
+                lrows = rows_packed[rp:rp + lean_bucket * LEAN_WORDS]
+                lrows = lrows.reshape(lean_bucket, LEAN_WORDS)
+                rp += lean_bucket * LEAN_WORDS
+                full = jnp.zeros((lean_bucket, 34), jnp.uint32)
+                full = full.at[:, :LEAN_WORDS].set(lrows)
+                full = full.at[jnp.arange(lean_bucket), llen >> 2].add(
+                    jnp.uint32(1)
+                    << ((llen & 3) * 8).astype(jnp.uint32))
+                full = full.at[:, 33].add(jnp.uint32(0x80) << 24)
+                ai = cls_pos[1]
+                arenas[ai] = arenas[ai].at[lidx].set(full, mode="drop")
             dig = jnp.zeros((1 + g_pad, 8), jnp.uint32)
             for blocks, lanes, gstart, npatch, patch_off, lane_off in specs_t:
                 ai = cls_pos[blocks]
@@ -381,6 +534,9 @@ class ResidentExecutor:
                     (cls, rows, idx, _pow2_bucket(idx.shape[0])))
             len_off = export["off"].shape[0]
             len_rowidx = export["rowidx"].shape[0]
+            lean = export.get("lean")
+            n_lean = lean[1].shape[0] if lean is not None else 0
+            lean_bucket = _pow2_bucket(n_lean) if n_lean else 0
             specs_t = tuple(tuple(int(v) for v in s) for s in specs)
             fresh_t = tuple((cls, bucket, rows.shape[1])
                             for cls, rows, _, bucket in fresh_shapes)
@@ -390,7 +546,7 @@ class ResidentExecutor:
                 self._ensure_arena(cls, 1)  # segment-only classes must exist
             key = (specs_t, fresh_t, classes, self.store.shape[0],
                    tuple(self.arenas[c].shape[0] for c in classes),
-                   g_pad, len_off, len_rowidx)
+                   g_pad, len_off, len_rowidx, lean_bucket)
 
             # staging reuse (the plan cache's host half): warm commits
             # refill this signature's preallocated aux/rows buffers in
@@ -414,8 +570,10 @@ class ResidentExecutor:
                     busy.block_until_ready()
             else:
                 n_aux = (3 * len_off + len_rowidx + g_pad
-                         + sum(b for _, b, _ in fresh_t))
-                n_rows = sum(b * w for _, b, w in fresh_t)
+                         + sum(b for _, b, _ in fresh_t)
+                         + 2 * lean_bucket)
+                n_rows = (sum(b * w for _, b, w in fresh_t)
+                          + lean_bucket * LEAN_WORDS)
                 aux = np.zeros(n_aux, np.int32)
                 rows_packed = np.zeros(max(n_rows, 1), np.uint32)
             p = 0
@@ -436,11 +594,25 @@ class ResidentExecutor:
                 rows_packed[rp:rp + n * w] = rows.reshape(-1)
                 rows_packed[rp + n * w:rp + bucket * w] = 0
                 rp += bucket * w
+            if lean_bucket:
+                lrows, lidx, llen = lean
+                aux[p:p + n_lean] = lidx
+                aux[p + n_lean:p + lean_bucket] = 0  # pads -> scratch row
+                p += lean_bucket
+                aux[p:p + n_lean] = llen
+                aux[p + n_lean:p + lean_bucket] = 0  # pad len 0
+                p += lean_bucket
+                nw = n_lean * LEAN_WORDS
+                rows_packed[rp:rp + nw] = lrows.reshape(-1)
+                rows_packed[rp + nw:rp + lean_bucket * LEAN_WORDS] = 0
+                rp += lean_bucket * LEAN_WORDS
+            self.last_lean_rows = n_lean
+            self.last_lean_wire_bytes = n_lean * (4 * LEAN_WORDS + 8)
 
         fn = self._fused_program(key)
         with phase_timer("resident/phase/patch"):
-            rows_d = jax.device_put(rows_packed[:rp])
-            aux_d = jax.device_put(aux)
+            rows_d = self._put(rows_packed[:rp])
+            aux_d = self._put(aux)
             outs = fn(self.store, *(self.arenas[c] for c in classes),
                       rows_d, aux_d)
         with phase_timer("resident/phase/store"):
@@ -461,6 +633,8 @@ class ResidentExecutor:
 
             default_registry.counter("resident/h2d_bytes").inc(
                 self.h2d_bytes)
+            default_registry.counter("resident/lean_wire_bytes").inc(
+                self.last_lean_wire_bytes)
             self._note_collectives(export)
         return self.last_root
 
@@ -494,20 +668,49 @@ class ResidentExecutor:
                 idx = np.concatenate(
                     [idx, np.zeros(bucket - n, np.int32)])
             self.arenas[cls] = _scatter_rows(
-                self.arenas[cls], jax.device_put(rows), jax.device_put(idx))
+                self.arenas[cls], self._put(rows), self._put(idx))
             h2d += rows.nbytes + idx.nbytes
+
+        # lean class-1 records: the non-fused fallback expands them on
+        # the host (zero-extend to 34 words + keccak pad bits) and ships
+        # full rows — no wire savings here, so the diagnostics record the
+        # bytes actually uploaded, not the fused-path lean envelope
+        self.last_lean_rows = 0
+        self.last_lean_wire_bytes = 0
+        lean = export.get("lean")
+        if lean is not None and lean[1].shape[0]:
+            lrows, lidx, llen = lean
+            n = lidx.shape[0]
+            full = np.zeros((n, 34), np.uint32)
+            full[:, :LEAN_WORDS] = lrows
+            fb = full.view(np.uint8).reshape(n, 136)
+            fb[np.arange(n), llen] ^= 0x01
+            fb[:, 135] ^= 0x80
+            bucket = _pow2_bucket(n)
+            idx = lidx
+            if bucket != n:
+                full = np.concatenate(
+                    [full, np.zeros((bucket - n, 34), np.uint32)])
+                idx = np.concatenate(
+                    [idx, np.zeros(bucket - n, np.int32)])
+            self._ensure_arena(1, 1)
+            self.arenas[1] = _scatter_rows(
+                self.arenas[1], self._put(full), self._put(idx))
+            h2d += full.nbytes + idx.nbytes
+            self.last_lean_rows = n
+            self.last_lean_wire_bytes = full.nbytes + idx.nbytes
 
         meta = np.zeros((MAX_SEGMENTS, 3), np.int32)
         for i, s in enumerate(specs):
             meta[i] = (s[4], s[5], s[2])   # patch_off, lane_off, gstart
-        tables = [jax.device_put(export[k]) for k in
+        tables = [self._put(export[k]) for k in
                   ("off", "src", "oldidx", "rowidx")]
         h2d += sum(export[k].nbytes for k in
                    ("off", "src", "oldidx", "rowidx"))
-        lane_slot = jax.device_put(export["lane_slot"])
+        lane_slot = self._put(export["lane_slot"])
         h2d += export["lane_slot"].nbytes
-        mt = jax.device_put(meta)
-        seg_ids = jax.device_put(np.arange(MAX_SEGMENTS, dtype=np.int32))
+        mt = self._put(meta)
+        seg_ids = self._put(np.arange(MAX_SEGMENTS, dtype=np.int32))
         off, src, oldidx, rowidx = tables
 
         # bucket the dig height to a power of two: every jitted step is
@@ -538,6 +741,8 @@ class ResidentExecutor:
         from ..metrics import default_registry
 
         default_registry.counter("resident/h2d_bytes").inc(self.h2d_bytes)
+        default_registry.counter("resident/lean_wire_bytes").inc(
+            self.last_lean_wire_bytes)
         self._note_collectives(export)
         return self.last_root
 

@@ -15,6 +15,7 @@ mesh's 'batch' axis via NamedSharding + jit.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import List, Optional, Sequence
 
 import jax
@@ -62,6 +63,25 @@ def _check_width(n: int, what: str) -> None:
             f"planner bucket: segment lane counts are multiples of "
             f"{LANE_BUCKET}, so shards would be uneven — use a "
             f"power-of-two width <= {LANE_BUCKET}")
+
+
+def process_count() -> int:
+    """Number of jax processes in this runtime (1 = single-process)."""
+    try:
+        return int(jax.process_count())
+    except AttributeError:  # very old jax without the multi-process API
+        return 1
+
+
+def mesh_spans_processes(mesh: Mesh) -> bool:
+    """True when [mesh]'s devices belong to more than one jax process.
+
+    Multi-process readiness gate: a mesh that spans processes runs one
+    SPMD program per process, so any UNILATERAL local action on the
+    resident state (e.g. the demotion ladder rebuilding on a local
+    single device) would desync the other processes — callers must take
+    the collective-safe path instead."""
+    return len({d.process_index for d in mesh.devices.flat}) > 1
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "batch") -> Mesh:
@@ -174,8 +194,15 @@ def commit_step(mesh: Mesh, axis="batch"):
     collective path.
     """
     sharding = NamedSharding(mesh, P(axis))
+    replicated = NamedSharding(mesh, P())
 
-    @jax.jit
+    # explicit in/out shardings (SA012): the checksum must come back
+    # replicated and the digests stay lane-sharded — pinning both keeps
+    # chained steps reshard-free when the mesh spans processes (pjit
+    # multi-process recipe: never let placement be inferred per call)
+    @partial(jax.jit,
+             in_shardings=(sharding, sharding),
+             out_shardings=(sharding, replicated))
     def step(words, nblocks):
         out = keccak256_blocks(words, nblocks)  # [B, 8] uint32, sharded on B
         checksum = jnp.sum(out, dtype=jnp.uint32)  # cross-shard reduction

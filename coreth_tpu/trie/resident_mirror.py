@@ -79,7 +79,8 @@ class ResidentAccountMirror:
                  prefer_host: Optional[bool] = None,
                  pipeline_depth: int = 0,
                  template_residency: bool = False,
-                 mesh_devices: int = 0):
+                 mesh_devices: int = 0,
+                 lean_rows: bool = False):
         import os
 
         if cpu_threads is None or int(cpu_threads) <= 0:
@@ -187,6 +188,13 @@ class ResidentAccountMirror:
         if device_timeout is not None and device_timeout <= 0:
             device_timeout = None  # 0 disables the watchdog (config doc)
         self.device_timeout = device_timeout
+        # storage-lean node rows (PR 18, resident_lean_rows knob): the
+        # native planner ships fresh single-block rows as 80-byte wire
+        # records instead of 136-byte padded rows. A no-op for host
+        # commits, so it stays pinned across takeovers/demotions.
+        self.lean_rows = bool(lean_rows)
+        if self.lean_rows:
+            self.trie.set_lean(True)
         base = base_key if base_key is not None else self.GENESIS
         # flags BEFORE the genesis commit: a takeover during it must not
         # have its degradation markers clobbered below
@@ -265,6 +273,21 @@ class ResidentAccountMirror:
             return False  # bottom device rung: only the host is left
         from ..log import get_logger
         from ..metrics import default_registry
+
+        if bool(getattr(self.ex, "spans_processes", False)):
+            # multi-process mesh (PR 18): the single-device rung is a
+            # UNILATERAL local rebuild — on a mesh spanning jax
+            # processes it would desync the SPMD program on every other
+            # process. Skip straight to the host rung, which is local
+            # by construction.
+            default_registry.counter(
+                "state/resident/mesh_demotion_cross_process_skips").inc(1)
+            get_logger("state").error(
+                "mesh resident backend wedged (%s) on a mesh spanning "
+                "multiple processes — the single-device rung is "
+                "unavailable (local rebuild would desync SPMD peers); "
+                "escalating straight to the host takeover", why)
+            return False
 
         get_logger("state").error(
             "mesh resident backend wedged (%s) — demoting %d-shard mesh "
@@ -529,11 +552,9 @@ class ResidentAccountMirror:
         transitively verifies the whole device digest chain down from
         the root. The full export consumes the delta marks, so the next
         interval flush is degraded to a full image."""
-        import numpy as np
-
         from ..metrics import default_registry
         from ..native import keccak256_batch
-        from ..native.mpt import DeviceWedgedError, _run_with_watchdog
+        from ..native.mpt import DeviceWedgedError
 
         default_registry.counter("state/resident/spot_checks").inc(1)
         try:
@@ -565,13 +586,7 @@ class ResidentAccountMirror:
             else:
                 dev_root = self.trie.commit_resident_timed(
                     self.ex, self.device_timeout)
-                if self.device_timeout is None:
-                    store_np = np.asarray(self.ex.store)
-                else:
-                    store_np = _run_with_watchdog(
-                        lambda: np.asarray(self.ex.store),
-                        self.device_timeout, "spot-check store readback")
-                self.trie.absorb_store(store_np)
+                self._absorb_device_store("spot-check store readback")
         except DeviceWedgedError as e:
             # not a divergence: the ladder's failure mode. Degrade like
             # any wedged commit; a mesh demotion already verified the
@@ -596,6 +611,30 @@ class ResidentAccountMirror:
             default_registry.counter(
                 "state/resident/spot_check_failures").inc(1)
         return ok
+
+    def _absorb_device_store(self, what: str) -> None:
+        """Sync the device store into the host digest cache before an
+        export/spot-check read. Per-shard readback when the executor
+        speaks it (PR 18: shard-local store partitions, no replicated
+        host-side gather); executors exposing only `.store` (wrappers,
+        stubs) keep the legacy full readback. The watchdog wraps only
+        the d2h; absorb mutates the trie on THIS thread, so an
+        abandoned worker can't race it."""
+        import numpy as np
+
+        from ..native.mpt import _run_with_watchdog
+
+        reader = getattr(self.ex, "store_parts", None)
+        if reader is not None:
+            work, absorb = (lambda: list(reader())), \
+                self.trie.absorb_store_parts
+        else:
+            work, absorb = (lambda: np.asarray(self.ex.store)), \
+                self.trie.absorb_store
+        if self.device_timeout is None:
+            absorb(work())
+        else:
+            absorb(_run_with_watchdog(work, self.device_timeout, what))
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -991,8 +1030,6 @@ class ResidentAccountMirror:
         Content-addressed writes make sibling/abandoned-branch nodes
         harmless on disk: they are unreachable garbage the offline
         pruner sweeps, exactly like the reference's stale hashdb nodes."""
-        import numpy as np
-
         if not self._dirty_since_export and not self._export_degraded and (
             at_block is None or self._applied[-1] == at_block
         ):
@@ -1019,7 +1056,7 @@ class ResidentAccountMirror:
         if self.host_mode:
             self.trie.commit_cpu(threads=self._cpu_threads)
         else:
-            from ..native.mpt import DeviceWedgedError, _run_with_watchdog
+            from ..native.mpt import DeviceWedgedError
 
             try:
                 if self.template:
@@ -1030,13 +1067,7 @@ class ResidentAccountMirror:
                 else:
                     self.trie.commit_resident_timed(
                         self.ex, self.device_timeout)
-                    if self.device_timeout is None:
-                        store_np = np.asarray(self.ex.store)
-                    else:
-                        store_np = _run_with_watchdog(
-                            lambda: np.asarray(self.ex.store),
-                            self.device_timeout, "store readback")
-                    self.trie.absorb_store(store_np)
+                    self._absorb_device_store("store readback")
             except DeviceWedgedError as e:
                 self._degrade(str(e))
                 if self.host_mode:

@@ -633,6 +633,86 @@ def test_sa011_real_worker_module_is_clean():
             if f.rule == "SA011"] == []
 
 
+# ---------------------------------------------------------------- SA012
+
+_SA012_PATH = "coreth_tpu/ops/keccak_resident.py"
+
+_SA012_BAD = """
+import functools
+import jax
+
+@jax.jit
+def scatter(arena, rows, idx):
+    return arena.at[idx].set(rows)
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(store, aux):
+    return store + aux
+
+def upload(x):
+    return jax.device_put(x)
+
+def make(fn):
+    return jax.jit(fn, static_argnums=(1,))
+"""
+
+
+def test_sa012_fires_on_unpinned_jit_and_device_put():
+    out = [f for f in findings(_SA012_BAD, _SA012_PATH)
+           if f.rule == "SA012"]
+    # bare @jax.jit, partial without shardings, 1-arg device_put,
+    # inline jit call without shardings
+    assert len(out) == 4
+    msgs = " ".join(f.message for f in out)
+    assert "in_shardings" in msgs
+    assert "device_put" in msgs
+
+
+def test_sa012_quiet_on_pinned_or_justified_sites():
+    src = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, in_shardings=(None,), out_shardings=None)
+    def pinned(x):
+        return x
+
+    # sharding: unsharded fallback only; mesh commits use the fused path
+    @jax.jit
+    def fallback(x):
+        return x
+
+    def make(kwargs):
+        # assembled kwargs are trusted (the sharded branch fills them)
+        return jax.jit(lambda s: s, **kwargs)
+
+    def upload(x, repl):
+        return jax.device_put(x, repl)
+    """
+    assert [f for f in findings(src, _SA012_PATH)
+            if f.rule == "SA012"] == []
+
+
+def test_sa012_quiet_outside_commit_path_modules():
+    # the same code is fine outside the mesh commit-path modules
+    for relpath in ("coreth_tpu/ops/keccak_jax.py",
+                    "coreth_tpu/core/blockchain.py"):
+        assert [f for f in findings(_SA012_BAD, relpath)
+                if f.rule == "SA012"] == []
+
+
+def test_sa012_real_commit_path_modules_are_clean():
+    import pathlib
+
+    import coreth_tpu.ops.keccak_resident as kr
+    import coreth_tpu.parallel as par
+
+    for mod, rel in ((kr, "coreth_tpu/ops/keccak_resident.py"),
+                     (par, "coreth_tpu/parallel/__init__.py")):
+        src = pathlib.Path(mod.__file__).read_text()
+        assert [f for f in findings(src, rel) if f.rule == "SA012"] == []
+
+
 # ------------------------------------------------------------ repo gate
 
 def test_repo_is_clean_modulo_baseline():

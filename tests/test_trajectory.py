@@ -258,6 +258,46 @@ class TestRegressionGate:
         assert list(out["series"].values())[0]["status"] == "short"
 
 
+class TestConfig20Ingestion:
+    """Config-20 bytes-per-commit envelope (PR 18): measured wire/h2d
+    series gate lower-is-better; the planned column is a MODEL and is
+    reported without gating."""
+
+    def test_wire_bytes_per_leaf_direction_and_provenance(self, tmp_path):
+        _suite(tmp_path, 1, 80.0, config=20,
+               metric="lean_row_wire_bytes_per_leaf", unit="B/leaf",
+               platform="xla-cpu-standin (no device leg)")
+        points, skipped = load_artifacts(str(tmp_path))
+        assert skipped == []
+        assert points[0]["provenance"] == "xla-cpu-standin"
+        out = build_trajectory(points, [])
+        s = out["series"][
+            "cfg=20|lean_row_wire_bytes_per_leaf|xla-cpu-standin"]
+        assert s["direction"] == "lower"
+
+    def test_h2d_bytes_blowup_fails_check(self, tmp_path):
+        # the lean leg quietly shipping full rows again (2x the bytes)
+        # is exactly the regression the sentinel must trip on
+        for rnd, v in ((1, 67000.0), (2, 66500.0), (3, 67400.0),
+                       (4, 140000.0)):
+            _suite(tmp_path, rnd, v, config=20,
+                   metric="lean_h2d_bytes_per_commit", unit="B/commit")
+        assert main(["--check", "--root", str(tmp_path)]) == 1
+
+    def test_modeled_series_reported_never_gated(self, tmp_path):
+        # same blowup shape, but the metric is a model: unjudged, rc 0
+        for rnd, v in ((1, 250000.0), (2, 255000.0), (3, 249000.0),
+                       (4, 900000.0)):
+            _suite(tmp_path, rnd, v, config=20,
+                   metric="planned_modeled_bytes_per_commit",
+                   unit="B/commit")
+        assert main(["--check", "--root", str(tmp_path)]) == 0
+        out = json.loads((tmp_path / OUTPUT).read_text())
+        s = out["series"][
+            "cfg=20|planned_modeled_bytes_per_commit|xla-cpu-standin"]
+        assert s["status"] in ("short", "unjudged")
+
+
 class TestCLIContract:
     def test_empty_checkout_skips_cleanly(self, tmp_path, capsys):
         assert main(["--check", "--root", str(tmp_path)]) == 0

@@ -7,7 +7,7 @@ cd "$(dirname "$0")/.."
 
 rc=0
 
-echo "== coreth_tpu.analysis (AST lint: SA001-SA011, baseline-gated) =="
+echo "== coreth_tpu.analysis (AST lint: SA001-SA012, baseline-gated) =="
 python -m coreth_tpu.analysis || rc=1
 
 echo
