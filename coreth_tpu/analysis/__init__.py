@@ -27,11 +27,20 @@ __all__ = [
 
 def run_repo(package_root: Optional[Path] = None,
              baseline_path: Optional[Path] = None,
+             cache: bool = True,
+             engine: Optional[Engine] = None,
              ) -> Tuple[List[Finding], List[Finding], List[str], Dict[str, str]]:
     """Analyze the package. Returns (new, suppressed, unused_baseline_keys,
-    baseline) — `new` non-empty means the gate is red."""
-    engine = Engine(default_rules())
-    findings = engine.check_package(package_root or PACKAGE_ROOT)
+    baseline) — `new` non-empty means the gate is red.  With cache=True
+    (default) unchanged files replay from the per-file result cache (see
+    cache.py); pass an Engine to inspect `engine.program` afterwards."""
+    engine = engine if engine is not None else Engine(default_rules())
+    root = package_root or PACKAGE_ROOT
+    fc = None
+    if cache:
+        from .cache import FileCache, default_cache_path
+        fc = FileCache.load(default_cache_path(root))
+    findings = engine.check_package(root, cache=fc)
     bp = baseline_path if baseline_path is not None else BASELINE_PATH
     baseline = load_baseline(bp) if bp.exists() else {}
     new, suppressed, unused = apply_baseline(findings, baseline)

@@ -116,10 +116,30 @@ class Rule:
     def check(self, src: SourceFile) -> Iterator[Finding]:  # pragma: no cover
         raise NotImplementedError
 
+    def summarize(self, src: SourceFile):
+        """Optional picklable per-file digest for cross-file state.  The
+        engine calls this right after check() on a fresh parse, caches
+        the result alongside the file's findings, and replays it through
+        absorb() on cache hits — so stateful rules stay correct when the
+        per-file passes are skipped entirely.  Contract: summarize() runs
+        only after check() on the same SourceFile."""
+        return None
+
+    def absorb(self, relpath: str, summary) -> None:
+        """Feed back a (possibly cached) per-file summary before
+        finalize().  Files arrive in sorted-relpath order."""
+
     def finalize(self) -> Iterator[Finding]:
         """Cross-file pass, called once after every file has been
-        check()ed.  Stateful rules (SA006 failpoint registry) report
-        whole-package invariants here; the default has none."""
+        check()ed/absorb()ed.  Stateful rules (SA006 failpoint registry)
+        report whole-package invariants here; the default has none."""
+        return iter(())
+
+    def finalize_program(self, program) -> Iterator[Finding]:
+        """Interprocedural pass over the linked whole-repo
+        `callgraph.Program` (call edges, lock summaries, import
+        closure), called once after finalize().  SA013 and the
+        promoted SA003/SA010/SA011 live here; the default has none."""
         return iter(())
 
     def finding(self, src: SourceFile, node: ast.AST, qualname: str,
@@ -197,13 +217,30 @@ def apply_baseline(findings: List[Finding], baseline: Dict[str, str]):
 class Engine:
     def __init__(self, rules: Iterable[Rule]):
         self.rules = list(rules)
+        # the linked whole-repo Program from the last
+        # check_package()/check_program() run (for the --graph CLI)
+        self.program = None
+
+    def _check_one(self, src: SourceFile):
+        """(findings, {rule_id: summary}) for one parsed file."""
+        findings: List[Finding] = []
+        summaries: Dict[str, object] = {}
+        for rule in self.rules:
+            findings.extend(rule.check(src))
+            s = rule.summarize(src)
+            if s is not None:
+                summaries[rule.id] = s
+        return findings, summaries
 
     def check_source(self, text: str, relpath: str = "<fixture>") -> List[Finding]:
+        """Single-file pass (per-file rules only; cross-file state is
+        absorbed so a later finalize() on this engine sees it)."""
         src = SourceFile.from_source(text, relpath)
-        out: List[Finding] = []
+        findings, summaries = self._check_one(src)
         for rule in self.rules:
-            out.extend(rule.check(src))
-        return out
+            if rule.id in summaries:
+                rule.absorb(relpath, summaries[rule.id])
+        return findings
 
     def check_file(self, path: Path, root: Path) -> List[Finding]:
         rel = path.relative_to(root.parent).as_posix()
@@ -217,12 +254,82 @@ class Engine:
             return [Finding("SA000", rel, exc.lineno or 0, "<module>",
                             f"syntax error: {exc.msg}")]
 
-    def check_package(self, package_root: Path) -> List[Finding]:
-        """Walk every .py under [package_root] (the coreth_tpu dir)."""
+    def check_program(self, sources: Iterable[Tuple[str, str]]
+                      ) -> List[Finding]:
+        """Full pipeline over in-memory (text, relpath) pairs: per-file
+        rules, cross-file finalize, and the interprocedural
+        finalize_program over the linked call graph.  This is what the
+        multi-file fixture tests drive; check_package is the same flow
+        plus the on-disk walk and cache."""
+        from . import callgraph
+
         out: List[Finding] = []
-        for path in sorted(package_root.rglob("*.py")):
-            out.extend(self.check_file(path, package_root))
+        graphs = []
+        for text, relpath in sources:
+            src = SourceFile.from_source(text, relpath)
+            findings, summaries = self._check_one(src)
+            out.extend(findings)
+            for rule in self.rules:
+                if rule.id in summaries:
+                    rule.absorb(relpath, summaries[rule.id])
+            graphs.append(callgraph.extract_file(src))
         for rule in self.rules:
             out.extend(rule.finalize())
+        self.program = callgraph.build_program(graphs)
+        for rule in self.rules:
+            out.extend(rule.finalize_program(self.program))
+        out.sort(key=lambda f: (f.path, f.line, f.rule))
+        return out
+
+    def check_package(self, package_root: Path,
+                      cache=None) -> List[Finding]:
+        """Walk every .py under [package_root] (the coreth_tpu dir).
+        With a `cache.FileCache`, unchanged files skip parse + per-file
+        rules + graph extraction entirely (findings, summaries, and the
+        FileGraph replay from the cache); the cross-file finalize and
+        the interprocedural link always run fresh."""
+        from . import callgraph
+
+        out: List[Finding] = []
+        graphs = []
+        for path in sorted(package_root.rglob("*.py")):
+            rel = path.relative_to(package_root.parent).as_posix()
+            entry = cache.lookup(path, rel) if cache is not None else None
+            if entry is None:
+                findings: List[Finding]
+                summaries: Dict[str, object] = {}
+                graph = None
+                try:
+                    text = path.read_text()
+                except (OSError, UnicodeDecodeError) as exc:
+                    findings = [Finding("SA000", rel, 0, "<module>",
+                                        f"unreadable: {exc}")]
+                else:
+                    try:
+                        src = SourceFile.from_source(text, rel)
+                    except SyntaxError as exc:
+                        findings = [Finding("SA000", rel, exc.lineno or 0,
+                                            "<module>",
+                                            f"syntax error: {exc.msg}")]
+                    else:
+                        findings, summaries = self._check_one(src)
+                        graph = callgraph.extract_file(src)
+                if cache is not None:
+                    cache.store(path, rel, findings, summaries, graph)
+            else:
+                findings, summaries, graph = entry
+            out.extend(findings)
+            if graph is not None:
+                graphs.append(graph)
+            for rule in self.rules:
+                if rule.id in summaries:
+                    rule.absorb(rel, summaries[rule.id])
+        for rule in self.rules:
+            out.extend(rule.finalize())
+        self.program = callgraph.build_program(graphs)
+        for rule in self.rules:
+            out.extend(rule.finalize_program(self.program))
+        if cache is not None:
+            cache.save()
         out.sort(key=lambda f: (f.path, f.line, f.rule))
         return out

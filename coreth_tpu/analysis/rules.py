@@ -368,15 +368,10 @@ class LockDisciplineRule(Rule):
 
 # ------------------------------------------------------------------ SA003
 
-WALLCLOCK_CALLS = {
-    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
-    "time.perf_counter", "time.perf_counter_ns", "time.process_time",
-    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
-    "datetime.datetime.utcnow",
-}
-RANDOM_ROOTS = ("random.", "np.random.", "numpy.random.", "secrets.")
-CTYPES_ALLOC = {"ctypes.create_string_buffer", "ctypes.create_unicode_buffer",
-                "create_string_buffer", "create_unicode_buffer"}
+# hard-impurity tables live in callgraph.py (the interprocedural
+# extractor shares them); re-exported here so fixtures/tests keep one
+# import path
+from .callgraph import CTYPES_ALLOC, RANDOM_ROOTS, WALLCLOCK_CALLS  # noqa: E402
 # Observability in a hot path must go through the gated helpers (they are
 # no-ops when tracing/metrics are off); constructing/looking-up a metric
 # or span object per call defeats the gate and allocates in the hot loop.
@@ -457,6 +452,41 @@ class HotPathPurityRule(Rule):
                     f"or use the gated phase_timer/expensive_timer/span "
                     f"helpers")
         return None
+
+    # -- interprocedural promotion ---------------------------------------
+    # A `# hot-path` marker covers the whole call tree, not one frame:
+    # a helper that reads the wall clock is just as impure when reached
+    # through two calls.  Transitive callees are held to the HARD subset
+    # only (wall clock / randomness / ctypes alloc) — the observability
+    # style checks stay single-file, where the hot marker is visible.
+    # Exempt: the gated observability packages themselves, and the
+    # cooperative-deadline checkpoint (its monotonic read at EVM frame
+    # entry is the sanctioned PR-7 design — never in step loops).
+    HOT_REACH_EXEMPT = (
+        "coreth_tpu/metrics/",
+        "coreth_tpu/fault/",
+        "coreth_tpu/log.py",
+        "coreth_tpu/utils/deadline.py",
+    )
+
+    def finalize_program(self, program) -> Iterator[Finding]:
+        seeds = sorted(k for k, n in program.funcs.items() if n.rec.hot)
+        if not seeds:
+            return
+        seen = program.reachable(seeds, skip=self.HOT_REACH_EXEMPT)
+        for key in sorted(seen):
+            parent, _line = seen[key]
+            if parent is None:
+                continue  # the seed itself — the single-file pass owns it
+            node = program.funcs[key]
+            if not node.rec.impure:
+                continue
+            chain = " -> ".join(program.chain_to(seen, key))
+            for site in node.rec.impure:
+                yield Finding(
+                    self.id, node.relpath, site.line, node.rec.qualname,
+                    f"{site.kind} (`{site.name}`) reached from a "
+                    f"# hot-path function: {chain}")
 
 
 # ------------------------------------------------------------------ SA004
@@ -645,9 +675,19 @@ class FailpointHygieneRule(Rule):
     title = "failpoint hygiene / naked time.sleep"
 
     def __init__(self):
-        # cross-file state, reported in finalize()
-        self._registered: Dict[str, Tuple[str, str]] = {}  # name -> site
-        self._fired: List[Tuple[str, str, int, str]] = []  # name, path, line, qn
+        # cross-file state, fed by absorb() (directly or replayed from
+        # the cache) and reported in finalize(); check() only stashes
+        # the current file's events for summarize() to hand back
+        self._pending: List[Tuple] = []
+        self._events: List[Tuple[str, Tuple]] = []  # (relpath, event)
+
+    def summarize(self, src: SourceFile):
+        events, self._pending = self._pending, []
+        return events or None
+
+    def absorb(self, relpath: str, summary) -> None:
+        for ev in summary:
+            self._events.append((relpath, ev))
 
     def check(self, src: SourceFile) -> Iterator[Finding]:
         rule = self
@@ -731,23 +771,31 @@ class FailpointHygieneRule(Rule):
                     f"failpoint {name!r} registered inside {qualname} — "
                     f"registration must run at import (module scope) so "
                     f"debug_listFailpoints is complete at boot"))
-            prior = self._registered.get(name)
-            if prior is not None and prior != (src.relpath, qualname):
-                out.append(self.finding(
-                    src, node, qualname,
-                    f"failpoint {name!r} already registered at "
-                    f"{prior[0]} [{prior[1]}] — names are global and must "
-                    f"be unique"))
-            else:
-                self._registered[name] = (src.relpath, qualname)
+            self._pending.append(("reg", name, getattr(node, "lineno", 0),
+                                  qualname))
         else:
-            self._fired.append((name, src.relpath,
-                                getattr(node, "lineno", 0), qualname))
+            self._pending.append(("fire", name, getattr(node, "lineno", 0),
+                                  qualname))
         return out
 
     def finalize(self) -> Iterator[Finding]:
-        for name, path, line, qualname in self._fired:
-            if name not in self._registered:
+        registered: Dict[str, Tuple[str, str]] = {}
+        fired: List[Tuple[str, str, int, str]] = []
+        for relpath, (kind, name, line, qualname) in self._events:
+            if kind == "reg":
+                prior = registered.get(name)
+                if prior is not None and prior != (relpath, qualname):
+                    yield Finding(
+                        self.id, relpath, line, qualname,
+                        f"failpoint {name!r} already registered at "
+                        f"{prior[0]} [{prior[1]}] — names are global and "
+                        f"must be unique")
+                else:
+                    registered[name] = (relpath, qualname)
+            else:
+                fired.append((name, relpath, line, qualname))
+        for name, path, line, qualname in fired:
+            if name not in registered:
                 yield Finding(
                     self.id, path, line, qualname,
                     f"failpoint({name!r}) fires a name no module "
@@ -1066,6 +1114,45 @@ class ReadTierLockRule(Rule):
         V().visit(src.tree)
         return iter(findings)
 
+    # -- interprocedural promotion ---------------------------------------
+    # The single-file pass only sees `chainmu` named IN the read tier; a
+    # read-tier entry that calls a helper in core/ that takes chainmu is
+    # the same bug one hop removed.  BFS every read-tier function's
+    # transitive callees; flag any reached function that acquires
+    # `BlockChain.chainmu` or IS one of the curated chainmu-taking chain
+    # methods.  Findings anchor at the read-tier entry (stable baseline
+    # key inside eth/), with the full call chain in the message.
+    def finalize_program(self, program) -> Iterator[Finding]:
+        entries = sorted(k for k, n in program.funcs.items()
+                         if n.relpath in READ_TIER_PATHS)
+        if not entries:
+            return
+        seen = program.reachable(entries)
+        for key in sorted(seen):
+            node = program.funcs[key]
+            if node.relpath in READ_TIER_PATHS:
+                continue  # direct uses are the single-file rule's job
+            if any(lock == "BlockChain.chainmu"
+                   for lock, _l, _h, _s in node.acquires):
+                culprit = f"`{node.rec.qualname}` acquires `chainmu`"
+            elif (node.rec.cls == "BlockChain"
+                    and node.rec.name in CHAINMU_TAKING_METHODS):
+                culprit = (f"`BlockChain.{node.rec.name}` is a curated "
+                           f"chainmu-taking method")
+            else:
+                continue
+            root = key
+            while seen[root][0] is not None:
+                root = seen[root][0]
+            entry_node = program.funcs[root]
+            chain = " -> ".join(program.chain_to(seen, key))
+            yield Finding(
+                self.id, entry_node.relpath, entry_node.rec.line,
+                entry_node.rec.qualname,
+                f"read-tier entry transitively reaches the write path: "
+                f"{chain} — {culprit}; reads resolve against "
+                f"chain.read_view(), never chainmu")
+
 
 # ------------------------------------------------------------------ SA011
 
@@ -1212,6 +1299,96 @@ class ShardWorkerIsolationRule(Rule):
         V().visit(src.tree)
         return iter(findings)
 
+    # -- interprocedural promotion ---------------------------------------
+    # The single-file pass pins shard_worker.py's own module scope; the
+    # promotion chases what actually executes in the forked child: every
+    # function reachable from the worker via the call graph, every lazy
+    # import those functions perform, and the transitive MODULE-SCOPE
+    # import closure of every module so pulled in (importing a module
+    # executes its module scope, which imports more).  A banned package
+    # (metrics, blockchain) anywhere in that closure means the child's
+    # import image carries a parent-process singleton — the finding
+    # anchors at the chain's root (the import that starts the pull) and
+    # renders the full module chain.
+    def finalize_program(self, program) -> Iterator[Finding]:
+        worker_files = [program.files[rel] for rel in sorted(program.files)
+                        if rel in SHARD_WORKER_PATHS]
+        if not worker_files:
+            return
+        worker_keys = sorted(k for k, n in program.funcs.items()
+                             if n.relpath in SHARD_WORKER_PATHS)
+        seen = program.reachable(worker_keys)
+
+        # module -> (why, (relpath, qualname, line), parent_module|None)
+        origin: Dict[str, Tuple[str, Tuple[str, str, int], Optional[str]]] = {}
+        queue: List[str] = []
+
+        def add(target: str, why: str, anchor: Tuple[str, str, int],
+                parent: Optional[str]) -> None:
+            mod = program._nearest_module(target)
+            rel = program.modules.get(mod)
+            if rel in SHARD_WORKER_PATHS or mod in origin:
+                return
+            head = mod.rsplit(".", 1)[0]
+            if ("." in mod and head in origin
+                    and origin[head][1] == anchor):
+                # `from X import y` records both X and X.y; when X isn't
+                # in the analyzed set, X.y can't be trimmed to a known
+                # module — one tracked entry per import is enough
+                return
+            origin[mod] = (why, anchor, parent)
+            queue.append(mod)
+
+        for fg in worker_files:
+            for target, line in fg.module_imports:
+                add(target, "module-scope import",
+                    (fg.relpath, "<module>", line), None)
+        for key in sorted(seen):
+            node = program.funcs[key]
+            for li in node.rec.lazy_imports:
+                add(li.module,
+                    f"lazy import inside `{node.rec.qualname}` "
+                    f"(runs in the forked child)",
+                    (node.relpath, node.rec.qualname, li.line), None)
+            if node.relpath not in SHARD_WORKER_PATHS:
+                parent_key, line = seen[key]
+                pnode = (program.funcs[parent_key]
+                         if parent_key is not None else node)
+                add(node.module,
+                    f"defines `{node.rec.qualname}`, called from the "
+                    f"worker",
+                    (pnode.relpath, pnode.rec.qualname, line), None)
+        while queue:
+            mod = queue.pop(0)
+            rel = program.modules.get(mod)
+            if rel is None:
+                continue
+            for target, line in program.files[rel].module_imports:
+                add(target, "module-scope import",
+                    (rel, "<module>", line), mod)
+
+        for mod in sorted(origin):
+            banned = SHARD_WORKER_BANNED_MODULES.intersection(
+                mod.split("."))
+            if not banned:
+                continue
+            # walk back to the chain's root for the anchor + witness
+            chain: List[str] = []
+            cur: Optional[str] = mod
+            anchor = origin[mod][1]
+            while cur is not None:
+                why, anc, parent = origin[cur]
+                chain.append(f"{cur} ({why} at {anc[0]}:{anc[2]})")
+                anchor = anc
+                cur = parent
+            chain.reverse()
+            yield Finding(
+                self.id, anchor[0], anchor[2], anchor[1],
+                f"shard-worker import/call closure pulls in `{mod}` "
+                f"(banned: {', '.join(sorted(banned))}) — the forked "
+                f"child's import image carries a parent singleton: "
+                f"{' -> '.join(chain)}")
+
 
 # ------------------------------------------------------------------ SA012
 
@@ -1338,11 +1515,50 @@ class ShardingDisciplineRule(Rule):
         return iter(findings)
 
 
+# ------------------------------------------------------------------ SA013
+
+class LockOrderRule(Rule):
+    """Global lock-order deadlock lint.  The linker canonicalizes every
+    `with <lock>` / `.acquire()` site and every `# guarded-by:` entry
+    annotation to an owner-qualified lock identity, propagates
+    may-acquire sets through the call graph, and builds the lock-order
+    edge set (`held -> acquired-under-it`).  A cycle in that graph is a
+    potential AB/BA deadlock: two threads entering the cycle from
+    different locks can block each other forever.  The finding carries
+    the full witness — the function chain, with files and lines, for
+    every edge of the cycle.  Reentrant re-acquisition of a held RLock
+    is not an edge (no self-edges), and a lock whose identity cannot be
+    resolved (generic attr name through an untyped receiver) is dropped
+    from the graph rather than risk a bogus unification cycle.
+
+    The acyclic order this rule certifies is mirrored at runtime by
+    `coreth_tpu.utils.racecheck.CANONICAL_LOCK_ORDER` (the lock-order
+    witness asserts observed acquisitions against it under the chaos
+    conductor); tests/test_static_analysis.py pins the two against each
+    other."""
+
+    id = "SA013"
+    title = "lock-order cycle (potential deadlock)"
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        return iter(())
+
+    def finalize_program(self, program) -> Iterator[Finding]:
+        for cycle in program.lock_cycles():
+            key, line, _action = cycle.edges[0].witness[0]
+            node = program.funcs[key]
+            yield Finding(
+                self.id, node.relpath, line, node.rec.qualname,
+                "lock-order cycle (potential deadlock):\n  "
+                + cycle.render(program.funcs).replace("\n", "\n  "))
+
+
 ALL_RULES: Tuple[type, ...] = (
     SilentExceptRule, LockDisciplineRule, HotPathPurityRule,
     ConsensusFloatRule, UnorderedIterationRule, FailpointHygieneRule,
     ServingBoundednessRule, BackendIsolationRule, FoldOrderRule,
     ReadTierLockRule, ShardWorkerIsolationRule, ShardingDisciplineRule,
+    LockOrderRule,
 )
 
 

@@ -103,7 +103,7 @@ def _handle_exec(conn, chain_config, req) -> None:
         os._exit(CRASH_EXIT)
 
     from ..evm.evm import EVM, BlockContext, TxContext
-    from .parallel_exec import (
+    from .mvcc import (
         _RecordingGasPool,
         _VersionedTable,
         VersionedStateView,

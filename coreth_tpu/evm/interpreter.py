@@ -19,7 +19,6 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import vmerrs
-from ..metrics import default_registry as _metrics
 from ..native import keccak256
 from ..utils.deadline import check as deadline_check
 from . import gas as G
@@ -1100,7 +1099,7 @@ def make_swap(n: int) -> ExecFn:
 
 def make_log(n_topics: int) -> ExecFn:
     def fn(interp, scope):
-        from ..state.statedb import Log
+        from ..state.log import Log
 
         st = scope.stack
         off = st.pop()
@@ -1823,6 +1822,10 @@ class Interpreter:
                 raise vmerrs.RevertError(data)  # SIG_REVERT
         finally:
             if classes:
-                reg = _metrics
+                # lazy: the interpreter runs inside forked shard workers,
+                # where a module-scope metrics import would alias the
+                # parent's registry (SA011); opclass attribution is a
+                # parent-only tracing feature
+                from ..metrics import default_registry as reg
                 for c, cnt in classes.items():
                     reg.counter("chain/opclass/" + c).inc(cnt)

@@ -41,8 +41,6 @@ import time
 import zlib
 from typing import Dict, List, Optional
 
-from ..metrics import default_registry
-
 # Fast-path gate: True iff at least one failpoint is currently armed.
 # Sites check this bare module bool before touching any dict or lock.
 enabled = False
@@ -203,6 +201,11 @@ def armed_spec(name: str) -> Optional[str]:
 
 
 def _fire_counter(name: str) -> None:
+    # imported lazily: `fault` sits in the forked shard worker's import
+    # closure, and a module-scope metrics import would copy the parent's
+    # registry singleton into every child image (SA011). Only the parent
+    # ever reaches this hook — child_after_fork() swaps in a no-op.
+    from ..metrics import default_registry
     default_registry.counter(f"fault/fired/{name}").inc()
 
 

@@ -295,10 +295,34 @@ class Conductor:
                         device_probe_interval=0.0),
             cfg, genesis, new_dummy_engine(), state_database=state_db,
         )
+        self.txpool = TxPool(TxPoolConfig(), cfg, self.chain)
         self.server = RPCServer()
         self.server.register_api("eth", EthAPI(EthBackend(
-            self.chain, TxPool(TxPoolConfig(), cfg, self.chain))))
+            self.chain, self.txpool)))
         self.genesis_hash = self.chain.get_canonical_hash(0)
+
+        # lock-order witness (invariant #6): every chain-path lock from
+        # racecheck.CANONICAL_LOCK_ORDER that exists in this topology is
+        # swapped for an order-tracking proxy, immediately after
+        # construction so no Condition can capture a raw inner lock.
+        from ..utils.racecheck import LockOrderWitness
+        self.witness = LockOrderWitness()
+        self.witness.wrap(self.chain, "chainmu", "BlockChain.chainmu")
+        self.witness.wrap(self.chain, "_acceptor_tip_lock",
+                          "BlockChain._acceptor_tip_lock")
+        self.witness.wrap(self.chain, "_insert_recs_mu",
+                          "BlockChain._insert_recs_mu")
+        self.witness.wrap(self.chain, "_view_mu", "BlockChain._view_mu")
+        self.witness.wrap(self.chain, "_degraded_mu",
+                          "BlockChain._degraded_mu")
+        if getattr(self.chain, "pipeline", None) is not None:
+            self.witness.wrap(self.chain.pipeline, "_mu",
+                              "InsertPipeline._mu")
+        if self.chain.snaps is not None:
+            self.witness.wrap(self.chain.snaps, "lock", "Tree.lock")
+        self.witness.wrap(self.txpool, "mu", "TxPool.mu")
+        self.witness.wrap(default_registry, "_lock", "Registry._lock")
+
         self.watchdog = _Watchdog(self.step_budget)
         self.expected = _expected_types()
 
@@ -308,6 +332,10 @@ class Conductor:
             self.chain.stop()
         except Exception as e:  # noqa: BLE001 - teardown is best-effort
             self._record_violation("shutdown", f"chain.stop failed: {e!r}")
+        if getattr(self, "witness", None) is not None:
+            # the metrics registry is process-global; it must not keep a
+            # witness proxy once this conductor is gone
+            self.witness.unwrap_all()
         self.watchdog.close()
 
     def _record_violation(self, what: str, detail: str, step: int = -1) -> None:
@@ -735,6 +763,12 @@ class Conductor:
             self._record_violation("armed-leak",
                           f"{[a['name'] for a in leftovers]} still armed "
                           f"after recovery", step)
+        # 6. lock-order witness: no thread acquired canonical locks out
+        # of order during the step (runtime twin of the SA013 lint)
+        if self.witness.violations:
+            for v in self.witness.violations:
+                self._record_violation("lock-order", v, step)
+            self.witness.violations = []
 
     # ---- kill drill ------------------------------------------------------
 

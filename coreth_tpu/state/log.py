@@ -1,0 +1,28 @@
+"""`Log` — an EVM log record, split out of `statedb`.
+
+The interpreter's LOG0..LOG4 handlers construct these inside the forked
+shard workers, and `statedb` wires snapshot counters at module scope; a
+`Log` import must not drag the parent's metrics registry into the child
+image (SA011 worker-isolation pass). This module stays dependency-free.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+class Log:
+    __slots__ = (
+        "address", "topics", "data", "block_number", "tx_hash", "tx_index",
+        "block_hash", "index",
+    )
+
+    def __init__(self, address: bytes, topics: List[bytes], data: bytes):
+        self.address = address
+        self.topics = topics
+        self.data = data
+        self.block_number = 0
+        self.tx_hash = b"\x00" * 32
+        self.tx_index = 0
+        self.block_hash = b"\x00" * 32
+        self.index = 0

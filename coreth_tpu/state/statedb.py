@@ -38,21 +38,7 @@ _snap_generating = _metrics.counter("state/snap/generating")
 from .state_object import RIPEMD_ADDR  # noqa: F401  (journal touch quirk)
 
 
-class Log:
-    __slots__ = (
-        "address", "topics", "data", "block_number", "tx_hash", "tx_index",
-        "block_hash", "index",
-    )
-
-    def __init__(self, address: bytes, topics: List[bytes], data: bytes):
-        self.address = address
-        self.topics = topics
-        self.data = data
-        self.block_number = 0
-        self.tx_hash = b"\x00" * 32
-        self.tx_index = 0
-        self.block_hash = b"\x00" * 32
-        self.index = 0
+from .log import Log  # noqa: F401 — canonical home is metrics-free
 
 
 class StateDB:

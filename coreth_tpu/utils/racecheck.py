@@ -9,7 +9,7 @@ any wall-clock OVERLAP of two guarded calls from different threads is
 recorded as a violation: if the owner's locks are correct, guarded
 mutators can never overlap no matter how hard tests hammer the object.
 
-Two modes:
+Three modes:
 
 * `guard(obj, methods)` — overlap detection: any wall-clock overlap of
   two guarded calls from different threads is a violation.
@@ -20,6 +20,13 @@ Two modes:
   catches a caller that never takes the lock even when no other thread
   happens to be inside) and is the runtime twin of the static SA002
   `# guarded-by:` annotations.
+* `LockOrderWitness` — acquisition-order detection: named locks are
+  swapped for proxies that maintain a per-thread held stack; acquiring
+  a lock ranked EARLIER in `CANONICAL_LOCK_ORDER` than one already held
+  is a violation.  This is the runtime twin of the static SA013
+  lock-order lint: SA013 proves the may-acquire graph is acyclic under
+  its naming/resolution model, the witness checks that real executions
+  match the canonical linearisation of that graph.
 
 Usage (tests/test_race_discipline.py):
 
@@ -34,7 +41,39 @@ from __future__ import annotations
 
 import functools
 import threading
-from typing import List
+from typing import List, Optional, Sequence, Tuple
+
+# The canonical single-process lock order, outermost first.  This is the
+# checked-in linearisation of the may-acquire graph the static analyzer
+# derives (SA013; `python -m coreth_tpu.analysis --graph locks` prints
+# the live graph) restricted to the locks the chain's write/serve paths
+# actually nest.  tests/test_static_analysis.py asserts every statically
+# observed edge between members agrees with this tuple, so a refactor
+# that inverts a nesting fails the lint before the witness ever runs.
+#
+# Notes on placement:
+#  * BlockChain._degraded_mu has no static edge ordering it against
+#    chainmu (the tail worker takes it bare); it sits after the chainmu
+#    cluster because VM._build_block_inner's closure may take it while
+#    VM.lock is held.
+#  * InsertPipeline._mu never nests with chainmu by design (the commit
+#    worker drains its queue BEFORE entering chainmu); listing both
+#    still lets the witness catch a regression that nests them the
+#    wrong way around.
+CANONICAL_LOCK_ORDER: Tuple[str, ...] = (
+    "VMServer._lock",
+    "BlockBuilder.lock",
+    "VM.lock",
+    "BlockChain.chainmu",
+    "BlockChain._acceptor_tip_lock",
+    "BlockChain._insert_recs_mu",
+    "BlockChain._view_mu",
+    "BlockChain._degraded_mu",
+    "InsertPipeline._mu",
+    "TxPool.mu",
+    "Registry._lock",
+    "Tree.lock",
+)
 
 
 class _OwnedLock:
@@ -81,6 +120,138 @@ class _OwnedLock:
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
+
+
+class _WitnessLock:
+    """Proxy that reports acquire/release to a LockOrderWitness.
+
+    Same delegation contract as `_OwnedLock`: only acquire/release (and
+    the context-manager surface) are intercepted; `locked()`, timeouts
+    and everything else pass through.  A failed `acquire(blocking=False)`
+    is NOT reported — only actual possession enters the held stack.
+    """
+
+    def __init__(self, inner, name: str, witness: "LockOrderWitness"):
+        self._inner = inner
+        self._name = name
+        self._witness = witness
+
+    def acquire(self, *a, **kw):
+        got = self._inner.acquire(*a, **kw)
+        if got:
+            self._witness._note_acquire(self._name)
+        return got
+
+    def release(self):
+        self._inner.release()
+        self._witness._note_release(self._name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class LockOrderWitness:
+    """Runtime lock-order recorder + checker (the SA013 runtime twin).
+
+    `wrap(obj, attr, name)` swaps [obj].[attr] for a `_WitnessLock`
+    whose canonical [name] matches the static analyzer's `Owner.attr`
+    naming.  Each thread keeps a stack of held lock names; on every
+    acquisition the witness
+
+      * records an observed edge (held -> acquired) for each lock the
+        thread already holds (re-entrant re-acquisition of the same
+        name is skipped — chainmu is an RLock), and
+      * flags a violation if the acquired lock is ranked EARLIER in the
+        canonical order than any held lock.  Locks absent from the
+        order are recorded (the edge set is still useful triage) but
+        never flagged, so partially instrumented runs stay quiet.
+
+    Known blind spot: a `threading.Condition` constructed on a lock
+    BEFORE the wrap keeps a reference to the raw inner lock, so waits/
+    notifies through the condition bypass the proxy.  None of the locks
+    in `CANONICAL_LOCK_ORDER` back a Condition today; the chaos
+    conductor wraps at boot, right after construction, to keep it that
+    way.
+    """
+
+    def __init__(self, order: Sequence[str] = CANONICAL_LOCK_ORDER):
+        self._rank = {name: i for i, name in enumerate(order)}
+        self.violations: List[str] = []
+        # observed (outer, inner) pairs, for edge-set assertions in tests
+        self.edges: set = set()
+        self._meta = threading.Lock()
+        self._held = threading.local()
+        self._wrapped: List[tuple] = []
+
+    def wrap(self, obj, attr: str, name: Optional[str] = None):
+        """Swap [obj].[attr] for a witness proxy named `Owner.attr` (or
+        [name]).  Idempotent: an already-wrapped lock is left alone."""
+        inner = getattr(obj, attr)
+        if isinstance(inner, _WitnessLock):
+            return inner
+        proxy = _WitnessLock(
+            inner, name or f"{type(obj).__name__}.{attr}", self)
+        setattr(obj, attr, proxy)
+        self._wrapped.append((obj, attr, inner))
+        return proxy
+
+    def unwrap_all(self) -> None:
+        """Restore every wrapped attribute (process-global singletons —
+        the metrics registry — must not keep witness proxies after the
+        harness that installed them is torn down)."""
+        for obj, attr, inner in reversed(self._wrapped):
+            try:
+                setattr(obj, attr, inner)
+            except AttributeError:
+                pass
+        self._wrapped.clear()
+
+    def _stack(self) -> List[str]:
+        st = getattr(self._held, "stack", None)
+        if st is None:
+            st = self._held.stack = []
+        return st
+
+    def _note_acquire(self, name: str) -> None:
+        stack = self._stack()
+        if name in stack:  # RLock re-entry: no new edge, no new rank
+            stack.append(name)
+            return
+        rank = self._rank.get(name)
+        with self._meta:
+            for held in stack:
+                if held != name:
+                    self.edges.add((held, name))
+            if rank is not None:
+                worst = [h for h in stack
+                         if self._rank.get(h, -1) > rank]
+                if worst:
+                    self.violations.append(
+                        f"thread {threading.get_ident()} acquired {name} "
+                        f"(rank {rank}) while holding "
+                        f"{' -> '.join(dict.fromkeys(stack))} "
+                        f"(violates canonical order via {worst[-1]})")
+        stack.append(name)
+
+    def _note_release(self, name: str) -> None:
+        stack = self._stack()
+        # release order need not mirror acquire order; drop the deepest
+        # occurrence so re-entrant holds unwind correctly
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                return
 
 
 class RaceDetector:

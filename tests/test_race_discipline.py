@@ -266,3 +266,91 @@ def test_txpool_concurrent_adds_lose_nothing():
         nonces = [t.nonce for t in txs]
         assert nonces == sorted(nonces) == list(range(len(txs)))
     chain2.stop()
+
+
+# ---------------------------------------------- lock-order witness (PR 19)
+
+def test_lock_order_witness_negative_selftest():
+    """The witness itself must trip on a deliberately inverted
+    acquisition — a silent witness would let invariant #6 in the chaos
+    conductor pass vacuously."""
+    from coreth_tpu.utils.racecheck import LockOrderWitness
+
+    class Chain:
+        pass
+
+    class Pool:
+        pass
+
+    chain, pool = Chain(), Pool()
+    chain.chainmu = threading.RLock()
+    pool.mu = threading.Lock()
+    w = LockOrderWitness()
+    w.wrap(chain, "chainmu", "BlockChain.chainmu")
+    w.wrap(pool, "mu", "TxPool.mu")
+
+    # canonical nesting (chainmu ranks before TxPool.mu): clean, and the
+    # reentrant re-acquisition is neither an edge nor a violation
+    with chain.chainmu:
+        with chain.chainmu:
+            with pool.mu:
+                pass
+    assert w.violations == []
+    assert ("BlockChain.chainmu", "TxPool.mu") in w.edges
+
+    # deliberate inversion: acquiring chainmu while holding TxPool.mu
+    with pool.mu:
+        with chain.chainmu:
+            pass
+    assert len(w.violations) == 1, w.violations
+    assert "BlockChain.chainmu" in w.violations[0]
+    assert "TxPool.mu" in w.violations[0]
+
+    # unknown locks are recorded but never flagged (partial runs stay quiet)
+    w.violations.clear()
+    other = Pool()
+    other.mu = threading.Lock()
+    w.wrap(other, "mu", "SomeUnlistedLock")
+    with other.mu:
+        with chain.chainmu:
+            pass
+    assert w.violations == []
+    assert ("SomeUnlistedLock", "BlockChain.chainmu") in w.edges
+
+    # unwrap restores the raw locks (global singletons must not keep proxies)
+    w.unwrap_all()
+    assert isinstance(chain.chainmu, type(threading.RLock()))
+    assert isinstance(pool.mu, type(threading.Lock()))
+
+
+def test_lock_order_witness_threads_are_independent():
+    """Held stacks are per-thread: thread B holding a late-ranked lock
+    must not poison thread A's early-ranked acquisition."""
+    from coreth_tpu.utils.racecheck import LockOrderWitness
+
+    class Chain:
+        pass
+
+    chain = Chain()
+    chain.chainmu = threading.RLock()
+    chain._view_mu = threading.Lock()
+    w = LockOrderWitness()
+    w.wrap(chain, "chainmu", "BlockChain.chainmu")
+    w.wrap(chain, "_view_mu", "BlockChain._view_mu")
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with chain._view_mu:
+            entered.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert entered.wait(5)
+    with chain.chainmu:  # other thread's _view_mu is not on OUR stack
+        pass
+    release.set()
+    t.join(5)
+    assert w.violations == [], w.violations

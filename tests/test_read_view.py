@@ -159,7 +159,17 @@ def test_concurrent_readers_see_only_fully_published_views():
     (accepted never ahead of preferred on a linear chain) and the
     stream of views per reader must be monotonic in seq and accepted
     height — a torn publication would break one of these."""
+    from coreth_tpu.utils.racecheck import LockOrderWitness
+
     chain = build_chain()
+    # runtime lock-order witness (SA013's runtime twin): the insert/
+    # accept writer nests these locks under the readers' noses; any
+    # acquisition inverting the canonical order is a violation
+    witness = LockOrderWitness()
+    witness.wrap(chain, "chainmu", "BlockChain.chainmu")
+    witness.wrap(chain, "_acceptor_tip_lock", "BlockChain._acceptor_tip_lock")
+    witness.wrap(chain, "_insert_recs_mu", "BlockChain._insert_recs_mu")
+    witness.wrap(chain, "_view_mu", "BlockChain._view_mu")
     blocks = make_blocks(chain, 24)
     stop = threading.Event()
     errors = []
@@ -202,6 +212,10 @@ def test_concurrent_readers_see_only_fully_published_views():
         for t in readers:
             t.join()
     assert not errors, errors[:5]
+    assert witness.violations == [], witness.violations[:5]
+    # the writer really did nest locks while we watched
+    assert ("BlockChain.chainmu", "BlockChain._view_mu") in witness.edges
+    witness.unwrap_all()
     chain.stop()
 
 
@@ -494,6 +508,11 @@ def test_mini_storm_keeps_slo_under_chaos_conductor():
         t.join(timeout=10)
     assert not run_err, run_err
     assert not bad, bad[:5]
+    # the conductor's per-step lock-order invariant (#6) covered this
+    # storm: the witness saw real nesting and recorded no inversions
+    assert not [v for v in cond.result["violations"]
+                if v["what"] == "lock-order"], cond.result["violations"]
+    assert cond.witness.edges, "lock-order witness saw no lock traffic"
     assert latencies, "storm produced no samples"
     latencies.sort()
     p99 = latencies[min(len(latencies) - 1, int(len(latencies) * 0.99))]

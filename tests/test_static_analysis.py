@@ -736,3 +736,317 @@ def test_cli_exits_zero_on_clean_repo():
         capture_output=True, text=True, timeout=120,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ------------------------------------------ interprocedural layer (PR 19)
+
+def _check_program(srcs):
+    """Full multi-file pipeline: per-file rules, cross-file finalize, and
+    finalize_program over the linked call graph."""
+    eng = Engine(default_rules())
+    out = eng.check_program(
+        [(textwrap.dedent(s), rel) for s, rel in srcs])
+    return out, eng
+
+
+_CYCLE_A = """
+import threading
+
+from .b import B
+
+
+class A:
+    def __init__(self):
+        self.mu = threading.Lock()
+        self.b = B()
+
+    def step(self):
+        with self.mu:
+            self.b.poke()
+"""
+
+_CYCLE_B = """
+import threading
+
+from .c import C
+
+
+class B:
+    def __init__(self):
+        self.mu = threading.Lock()
+        self.c = C()
+
+    def poke(self):
+        with self.mu:
+            self.c.kick()
+"""
+
+_CYCLE_C_BAD = """
+import threading
+
+from .a import A
+
+
+class C:
+    def __init__(self):
+        self.mu = threading.Lock()
+        self.a: A = None
+
+    def kick(self):
+        with self.mu:
+            self.a.step()
+"""
+
+_CYCLE_C_GOOD = """
+import threading
+
+
+class C:
+    def __init__(self):
+        self.mu = threading.Lock()
+
+    def kick(self):
+        with self.mu:
+            pass
+"""
+
+
+def test_sa013_fires_on_three_lock_cycle_across_three_files():
+    out, _eng = _check_program([
+        (_CYCLE_A, "coreth_tpu/fx/a.py"),
+        (_CYCLE_B, "coreth_tpu/fx/b.py"),
+        (_CYCLE_C_BAD, "coreth_tpu/fx/c.py"),
+    ])
+    sa13 = [f for f in out if f.rule == "SA013"]
+    assert len(sa13) == 1, out
+    msg = sa13[0].message
+    # all three locks are entangled (the rendered concrete cycle may be
+    # a transitive shortcut, but the SCC names every participant)
+    for lock in ("A.mu", "B.mu", "C.mu"):
+        assert lock in msg
+    # the witness names every file (with line numbers) and every fn hop
+    for rel in ("coreth_tpu/fx/a.py", "coreth_tpu/fx/b.py",
+                "coreth_tpu/fx/c.py"):
+        assert rel in msg
+    for fn in ("A.step", "B.poke", "C.kick"):
+        assert fn in msg
+
+
+def test_sa013_quiet_on_consistent_nesting():
+    out, eng = _check_program([
+        (_CYCLE_A, "coreth_tpu/fx/a.py"),
+        (_CYCLE_B, "coreth_tpu/fx/b.py"),
+        (_CYCLE_C_GOOD, "coreth_tpu/fx/c.py"),
+    ])
+    assert [f for f in out if f.rule == "SA013"] == []
+    # ...while the acyclic nesting is still observed as edges
+    edges = eng.program.lock_edges()
+    assert ("A.mu", "B.mu") in edges
+    assert ("B.mu", "C.mu") in edges
+
+
+_HOT_CALLER = """
+from .util import stamp
+
+
+# hot-path
+def step(batch):
+    return stamp(batch)
+"""
+
+_UTIL_IMPURE = """
+import time
+
+
+def stamp(batch):
+    return (time.time(), batch)
+"""
+
+_UTIL_PURE = """
+def stamp(batch):
+    return (len(batch), batch)
+"""
+
+_HOT_CALLER_EXEMPT = """
+from ..metrics.fxutil import stamp
+
+
+# hot-path
+def step(batch):
+    return stamp(batch)
+"""
+
+
+def test_sa003_promotion_fires_on_impure_transitive_callee():
+    out, _eng = _check_program([
+        (_HOT_CALLER, "coreth_tpu/fx/hot.py"),
+        (_UTIL_IMPURE, "coreth_tpu/fx/util.py"),
+    ])
+    sa3 = [f for f in out if f.rule == "SA003"]
+    assert len(sa3) == 1, out
+    f = sa3[0]
+    # the finding lands on the impure callee, with the hot chain spelled
+    assert f.path == "coreth_tpu/fx/util.py"
+    assert "wall-clock" in f.message
+    assert "step" in f.message and "stamp" in f.message
+
+
+def test_sa003_promotion_quiet_on_pure_callee_and_exempt_path():
+    out, _eng = _check_program([
+        (_HOT_CALLER, "coreth_tpu/fx/hot.py"),
+        (_UTIL_PURE, "coreth_tpu/fx/util.py"),
+    ])
+    assert [f for f in out if f.rule == "SA003"] == []
+    # gated observability packages are exempt from the promotion
+    out, _eng = _check_program([
+        (_HOT_CALLER_EXEMPT, "coreth_tpu/fx/hot.py"),
+        (_UTIL_IMPURE, "coreth_tpu/metrics/fxutil.py"),
+    ])
+    assert [f for f in out if f.rule == "SA003"] == []
+
+
+_ETH_ENTRY = """
+from ..core.helper import tip_sync
+
+
+def blockNumber(chain):
+    return tip_sync(chain)
+"""
+
+_CORE_HELPER_BAD = """
+def tip_sync(chain):
+    return chain.accept(None)
+"""
+
+_CORE_HELPER_GOOD = """
+def tip_sync(chain):
+    return chain.read_view().accepted
+"""
+
+_CORE_CHAIN_FX = """
+import threading
+
+
+class BlockChain:
+    def __init__(self):
+        self.chainmu = threading.RLock()
+
+    def accept(self, block):
+        with self.chainmu:
+            return block
+
+    def read_view(self):
+        return self
+"""
+
+
+def test_sa010_promotion_fires_on_transitive_chainmu_reach():
+    out, _eng = _check_program([
+        (_ETH_ENTRY, "coreth_tpu/eth/api.py"),
+        (_CORE_HELPER_BAD, "coreth_tpu/core/helper.py"),
+        (_CORE_CHAIN_FX, "coreth_tpu/core/chainfx.py"),
+    ])
+    sa10 = [f for f in out if f.rule == "SA010"]
+    assert len(sa10) == 1, out
+    f = sa10[0]
+    # anchored at the read-tier ENTRY (stable baseline key in eth/)
+    assert f.path == "coreth_tpu/eth/api.py"
+    assert f.qualname == "blockNumber"
+    assert "tip_sync" in f.message and "chainmu" in f.message
+
+
+def test_sa010_promotion_quiet_on_view_resolving_helper():
+    out, _eng = _check_program([
+        (_ETH_ENTRY, "coreth_tpu/eth/api.py"),
+        (_CORE_HELPER_GOOD, "coreth_tpu/core/helper.py"),
+        (_CORE_CHAIN_FX, "coreth_tpu/core/chainfx.py"),
+    ])
+    assert [f for f in out if f.rule == "SA010"] == []
+
+
+_WORKER_FX = """
+def handle(req):
+    from .wutil import go
+
+    return go(req)
+"""
+
+_WUTIL_BAD = """
+from ..metrics import default_registry
+
+
+def go(req):
+    return req
+"""
+
+_WUTIL_GOOD = """
+def go(req):
+    return req
+"""
+
+
+def test_sa011_promotion_fires_on_closure_dragging_metrics():
+    out, _eng = _check_program([
+        (_WORKER_FX, "coreth_tpu/core/shard_worker.py"),
+        (_WUTIL_BAD, "coreth_tpu/core/wutil.py"),
+    ])
+    sa11 = [f for f in out if f.rule == "SA011"]
+    assert len(sa11) == 1, out
+    f = sa11[0]
+    # anchored at the chain's root inside the worker, full module chain
+    assert f.path == "coreth_tpu/core/shard_worker.py"
+    assert "coreth_tpu.metrics" in f.message
+    assert "wutil" in f.message
+
+
+def test_sa011_promotion_quiet_on_clean_closure():
+    out, _eng = _check_program([
+        (_WORKER_FX, "coreth_tpu/core/shard_worker.py"),
+        (_WUTIL_GOOD, "coreth_tpu/core/wutil.py"),
+    ])
+    assert [f for f in out if f.rule == "SA011"] == []
+
+
+# ----------------------------- static order vs runtime witness constant
+
+def test_canonical_lock_order_matches_static_graph():
+    """Pin racecheck.CANONICAL_LOCK_ORDER against the real repo's lock
+    graph: the graph must be acyclic, every statically observed edge
+    between constant members must agree with the constant's order, and
+    the core chainmu nesting must actually be in the graph (so this
+    test cannot silently pass on an empty analysis)."""
+    from coreth_tpu.utils.racecheck import CANONICAL_LOCK_ORDER
+
+    eng = Engine(default_rules())
+    run_repo(engine=eng)
+    program = eng.program
+    assert program is not None
+    assert program.lock_cycles() == []
+    edges = program.lock_edges()
+    assert ("BlockChain.chainmu", "BlockChain._view_mu") in edges
+    rank = {n: i for i, n in enumerate(CANONICAL_LOCK_ORDER)}
+    bad = [(a, b) for (a, b) in edges
+           if a in rank and b in rank and rank[a] >= rank[b]]
+    assert bad == [], \
+        f"static lock edges contradict CANONICAL_LOCK_ORDER: {bad}"
+
+
+def test_cli_graph_mode_prints_lock_graph():
+    proc = subprocess.run(
+        [sys.executable, "-m", "coreth_tpu.analysis", "--graph", "locks"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "lock-order graph:" in proc.stdout
+    assert "BlockChain.chainmu -> BlockChain._view_mu" in proc.stdout
+
+
+def test_cli_graph_mode_prints_function_lock_sets():
+    proc = subprocess.run(
+        [sys.executable, "-m", "coreth_tpu.analysis",
+         "--graph", "BlockChain.insert_block"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "BlockChain.chainmu" in proc.stdout
+    assert "->" in proc.stdout  # callees are listed

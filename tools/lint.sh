@@ -7,8 +7,11 @@ cd "$(dirname "$0")/.."
 
 rc=0
 
-echo "== coreth_tpu.analysis (AST lint: SA001-SA012, baseline-gated) =="
-python -m coreth_tpu.analysis || rc=1
+echo "== coreth_tpu.analysis (AST lint + interprocedural: SA001-SA013) =="
+# --strict-baseline: stale allowlist entries fail too, so a fixed
+# finding can't leave a masking entry behind; the run includes the
+# whole-program passes (call graph, lock-order lint, promotions)
+python -m coreth_tpu.analysis --strict-baseline || rc=1
 
 echo
 echo "== coreth_tpu.core.exec_shards --smoke (fork/kill/respawn shard pool) =="
@@ -28,7 +31,8 @@ python -m coreth_tpu.bench.trajectory --check || rc=1
 echo
 echo "== coreth_tpu.fault.chaos (deterministic chaos smoke, seed 1) =="
 # skips cleanly (exit 0) when jax is unavailable in the lint image;
-# any invariant violation in the 50-step conductor run fails the lint
+# any invariant violation in the 50-step conductor run fails the lint —
+# including #6, the runtime lock-order witness (SA013's runtime twin)
 if python -c "import jax" >/dev/null 2>&1; then
     JAX_PLATFORMS=cpu python -m coreth_tpu.fault.chaos --steps 50 --seed 1 \
         || rc=1
