@@ -33,6 +33,10 @@ Configs (BASELINE.md "measurable baselines"):
   20 bytes-per-commit envelope A/B — storage-lean node rows (80 B/leaf
      wire records) vs template full rows vs the planned path's modeled
      upload, roots checked against the CPU host oracle every round
+  21 sampling-profiler overhead A/B — profiler off vs 25 Hz vs 100 Hz
+     over the config-10-shaped insert leg and the config-18 storm leg;
+     mean overhead at 25 Hz gated <= 2% here (the trajectory sentinel
+     reports the "overhead" series without gating)
 
 Each line: {"metric", "value", "unit", "vs_baseline", "config"} where
 vs_baseline compares the accelerated path against the host baseline of
@@ -1276,6 +1280,91 @@ def bench_20():
         }), flush=True)
 
 
+def bench_21():
+    """Sampling-profiler overhead A/B (config-21, PR 20): the
+    metrics/profiler.py stack sampler off vs on at 25 Hz and 100 Hz,
+    over two legs — the config-10-shaped block-insert leg
+    (_block_insert_rate, ecrecover + EVM + commit) and the config-18
+    storm leg (abbreviated bench_storm ladder, lock-free view reads
+    under insert load). Each (leg, hz) cell is the best of two runs so
+    a single descheduling blip on the shared box doesn't masquerade as
+    sampler cost. Overhead is 1 - on/off per leg; the gate is the mean
+    across legs at 25 Hz, budget 2%, enforced HERE where the A/B runs
+    back-to-back — the emitted metric name carries "overhead" so the
+    trajectory sentinel reports the cross-round series without gating
+    (round-to-round wall-clock noise on a 1-core container swamps a
+    sub-2% effect). Raw (possibly negative) overheads are reported,
+    not clamped: a faster-with-profiler leg is noise and says so."""
+    import bench_storm
+    from coreth_tpu.metrics.profiler import (get_profiler, start_profiler,
+                                             stop_profiler)
+
+    def insert_leg():
+        _, rate = _block_insert_rate()
+        return rate
+
+    def storm_leg():
+        result = bench_storm.main(["--duration", "0.6",
+                                   "--rates", "2000", "4000",
+                                   "--corpus", "100"])
+        return result["legs"]["view"]["saturation_per_sec"]
+
+    legs = (("insert", insert_leg), ("storm", storm_leg))
+    insert_leg()  # warm-up: compile/caches stay out of the A/B
+    rates = {}
+    samples = {}
+
+    def measure(hz):
+        if hz:
+            start_profiler(float(hz), ring_size=4096)
+        for name, fn in legs:
+            prev = rates.get((name, hz), 0.0)
+            rates[(name, hz)] = max(prev, fn(), fn())
+        if hz:
+            prof = get_profiler()
+            if prof is not None:
+                samples[hz] = samples.get(hz, 0) + \
+                    prof.dump()["samples_total"]
+            stop_profiler()
+
+    for hz in (0, 25, 100):
+        measure(hz)
+
+    def mean_overhead(hz):
+        return sum(1.0 - rates[(n, hz)] / rates[(n, 0)]
+                   for n, _ in legs) / len(legs)
+
+    if mean_overhead(25) > 0.02:
+        # one re-measure of the baseline and the 25 Hz cells before
+        # judging: best-of pools across passes
+        measure(0)
+        measure(25)
+    mean_25 = mean_overhead(25)
+    mean_100 = mean_overhead(100)
+    gate_pass = mean_25 <= 0.02
+    print(json.dumps({
+        "config": 21,
+        "host_mode": True,  # CPU wall-clock A/B: no device leg by design
+        "cores": os.cpu_count(),
+        "legs": {name: {f"{hz}hz": round(rates[(name, hz)], 1)
+                        for hz in (0, 25, 100)} for name, _ in legs},
+        "profiler_samples": {f"{hz}hz": samples.get(hz, 0)
+                             for hz in (25, 100)},
+        "overhead_pct": {
+            f"{hz}hz": {n: round(100.0 * (1.0 - rates[(n, hz)]
+                                          / rates[(n, 0)]), 2)
+                        for n, _ in legs} for hz in (25, 100)},
+        "gate_max_pct_25hz": 2.0,
+        "gate_pass": gate_pass,
+    }), flush=True)
+    _emit(21, "profiler_overhead_pct_25hz", 100.0 * mean_25, "%",
+          1.0 - mean_25)
+    if not gate_pass:
+        raise RuntimeError(
+            f"config-21 gate: sampling-profiler overhead at 25 Hz is "
+            f"{100.0 * mean_25:.2f}% > 2.0% budget")
+
+
 def main():
     from coreth_tpu.utils import enable_compilation_cache
 
@@ -1293,7 +1382,7 @@ def main():
     watchdog = PhaseWatchdog(
         time.monotonic() + float(os.environ.get("CORETH_TPU_BENCH_WATCHDOG",
                                                 "1800")))
-    picks = [int(a) for a in sys.argv[1:]] or list(range(1, 21))
+    picks = [int(a) for a in sys.argv[1:]] or list(range(1, 22))
     for i in picks:
         # configs 7/9 run bench.py legs under their own phase watchdogs
         # with larger budgets (900s cold warmup); the outer arm must not

@@ -44,11 +44,22 @@ SA012 sharding-discipline  jitted commit entries in the mesh-sharded
                        carry a `# sharding:` justification), and no
                        single-argument `device_put` — implicit placement
                        reshards chained commits across processes
+SA013 lock-order       the whole-program may-acquire graph must stay
+                       acyclic — a cycle is a potential deadlock; the
+                       acyclic order is mirrored at runtime by
+                       racecheck.CANONICAL_LOCK_ORDER and its witness
+SA014 metrics-family   Counter/Gauge/Meter/Timer/Histogram names created
+                       outside metrics/ must match the documented
+                       `^[a-z0-9_/]+$` namespace grammar (literal
+                       f-string/concat fragments: charset only) and one
+                       family name must never register under two
+                       different metric types
 """
 
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from .engine import Finding, QualnameVisitor, Rule, SourceFile
@@ -1172,11 +1183,42 @@ SHARD_WORKER_PATHS = (
 # internal packages a worker file may not import at ANY level — each one
 # drags in a parent-process singleton (metrics registry, chain + chainmu)
 SHARD_WORKER_BANNED_MODULES = {"metrics", "blockchain"}
+# the ONE sanctioned exception inside a banned package:
+# metrics/shardstats.py is fork-clean by construction (pure stdlib, no
+# registry, no locks, no threads, no module-level mutable state) and
+# exists precisely so workers can accumulate telemetry deltas and ship
+# them over the pipe instead of bumping parent singletons
+SHARD_WORKER_IMPORT_ALLOWLIST = frozenset({"metrics.shardstats"})
 # documented exceptions for module-level mutable bindings (none today;
 # additions need a reason next to the name)
 SHARD_WORKER_MUTABLE_ALLOWLIST: frozenset = frozenset()
 _MUTABLE_CTOR_NAMES = {"dict", "list", "set", "bytearray", "defaultdict",
                        "deque", "Counter", "OrderedDict"}
+
+
+def _worker_allowlist_tail(mod: str) -> str:
+    return mod[len("coreth_tpu."):] if mod.startswith("coreth_tpu.") else mod
+
+
+def _import_is_allowlisted(node: ast.AST) -> bool:
+    """True iff the statement imports ONLY allowlisted modules, under any
+    spelling: `from ..metrics.shardstats import ShardStats`,
+    `from ..metrics import shardstats`, `import
+    coreth_tpu.metrics.shardstats`."""
+    mods: List[str] = []
+    if isinstance(node, ast.Import):
+        mods = [a.name for a in node.names]
+    elif isinstance(node, ast.ImportFrom):
+        base = node.module or ""
+        if _worker_allowlist_tail(base) == "metrics":
+            # `from ..metrics import X, Y` — each alias is a module
+            mods = [f"{base}.{a.name}" for a in node.names]
+        else:
+            mods = [base]
+    if not mods:
+        return False
+    return all(_worker_allowlist_tail(m) in SHARD_WORKER_IMPORT_ALLOWLIST
+               for m in mods)
 
 
 def _import_segments(node: ast.AST) -> List[str]:
@@ -1235,7 +1277,8 @@ class ShardWorkerIsolationRule(Rule):
                     s == "coreth_tpu" for s in _import_segments(stmt))
                 ok = (not internal) or (
                     isinstance(stmt, ast.ImportFrom) and relative
-                    and _relative_is_fault_only(stmt))
+                    and _relative_is_fault_only(stmt)) \
+                    or _import_is_allowlisted(stmt)
                 if not ok:
                     findings.append(rule.finding(
                         src, stmt, "<module>",
@@ -1269,7 +1312,7 @@ class ShardWorkerIsolationRule(Rule):
             def _check_import(self, node: ast.AST) -> None:
                 banned = SHARD_WORKER_BANNED_MODULES.intersection(
                     _import_segments(node))
-                if banned:
+                if banned and not _import_is_allowlisted(node):
                     findings.append(rule.finding(
                         src, node, self.qualname,
                         f"shard-worker module imports "
@@ -1371,6 +1414,10 @@ class ShardWorkerIsolationRule(Rule):
             banned = SHARD_WORKER_BANNED_MODULES.intersection(
                 mod.split("."))
             if not banned:
+                continue
+            tail = _worker_allowlist_tail(mod)
+            if any(tail == a or tail.startswith(a + ".")
+                   for a in SHARD_WORKER_IMPORT_ALLOWLIST):
                 continue
             # walk back to the chain's root for the anchor + witness
             chain: List[str] = []
@@ -1553,12 +1600,150 @@ class LockOrderRule(Rule):
                 + cycle.render(program.funcs).replace("\n", "\n  "))
 
 
+# ------------------------------------------------------------------ SA014
+
+# The /metrics exposition sanitizes every registry name down to
+# `[a-zA-Z_][a-zA-Z0-9_]*` — two registry names that differ only in
+# separator characters silently COLLIDE into one exposition family, and
+# a name registered as a counter in one module and a gauge in another
+# raises at runtime only when the second call site finally executes.
+# The namespace grammar that keeps both failure modes impossible:
+# lower-case `[a-z0-9_/]` with `/` as the hierarchy separator (the
+# go-metrics convention every existing family follows).  metrics/ itself
+# is exempt: the sanitizer tests and the synthetic --check registry
+# exercise hostile names on purpose, and racecheck's lock/<canonical>
+# families (which legally carry `.`/`:`) are registered through the
+# metrics-adjacent telemetry helpers documented in OBSERVABILITY.md.
+METRICS_FAMILY_RE_SRC = r"^[a-z0-9_/]+$"
+_METRICS_FAMILY_RE = re.compile(METRICS_FAMILY_RE_SRC)
+_METRICS_FAMILY_CHARSET = frozenset("abcdefghijklmnopqrstuvwxyz0123456789_/")
+_METRICS_CTOR_METHODS = ("counter", "gauge", "meter", "timer", "histogram")
+_METRICS_EXEMPT_PREFIXES = ("coreth_tpu/metrics/", "coreth_tpu/utils/racecheck")
+
+
+def _metric_name_parts(node: ast.AST):
+    """(kind, literal_fragments) for a metric name argument: kind is
+    'literal' (whole name known), 'fragments' (f-string / concat — only
+    the constant pieces are checkable), or None (pure variable)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return "literal", [node.value]
+    if isinstance(node, ast.JoinedStr):
+        frags = [v.value for v in node.values
+                 if isinstance(v, ast.Constant) and isinstance(v.value, str)]
+        return "fragments", frags
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        frags: List[str] = []
+        for side in (node.left, node.right):
+            kind, sub = _metric_name_parts(side)
+            if kind == "literal":
+                frags.extend(sub)
+            elif kind == "fragments":
+                frags.extend(sub)
+        return "fragments", frags
+    return None, []
+
+
+class MetricsFamilyRule(Rule):
+    """Registry names created outside metrics/ must follow the
+    `^[a-z0-9_/]+$` namespace grammar, and one family name must never be
+    registered under two different metric types anywhere in the repo."""
+
+    id = "SA014"
+    title = "metric family name breaks the namespace grammar"
+
+    def __init__(self):
+        # name -> {metric type -> (relpath, qualname, line)} across files
+        self._families: Dict[str, Dict[str, Tuple[str, str, int]]] = {}
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        if src.relpath.startswith(_METRICS_EXEMPT_PREFIXES):
+            return iter(())
+        rule = self
+        findings: List[Finding] = []
+
+        class V(QualnameVisitor):
+            def visit_Call(self, node: ast.Call) -> None:
+                self.generic_visit(node)
+                func = node.func
+                if not (isinstance(func, ast.Attribute)
+                        and func.attr in _METRICS_CTOR_METHODS
+                        and node.args):
+                    return
+                kind, frags = _metric_name_parts(node.args[0])
+                if kind == "literal":
+                    name = frags[0]
+                    if not _METRICS_FAMILY_RE.match(name):
+                        findings.append(rule.finding(
+                            src, node, self.qualname,
+                            f"metric name {name!r} breaks the "
+                            f"`{METRICS_FAMILY_RE_SRC}` family grammar — "
+                            f"the exposition sanitizer folds every other "
+                            f"character to '_', silently colliding "
+                            f"families"))
+                elif kind == "fragments":
+                    for frag in frags:
+                        bad = set(frag) - _METRICS_FAMILY_CHARSET
+                        if bad:
+                            findings.append(rule.finding(
+                                src, node, self.qualname,
+                                f"metric name fragment {frag!r} carries "
+                                f"characters outside the "
+                                f"`{METRICS_FAMILY_RE_SRC}` family "
+                                f"grammar: {sorted(bad)}"))
+                            break
+
+        V().visit(src.tree)
+        return iter(findings)
+
+    def summarize(self, src: SourceFile):
+        if src.relpath.startswith(_METRICS_EXEMPT_PREFIXES):
+            return []
+        rows: List[Tuple[str, str, str, int]] = []
+
+        class V(QualnameVisitor):
+            def visit_Call(self, node: ast.Call) -> None:
+                self.generic_visit(node)
+                func = node.func
+                if not (isinstance(func, ast.Attribute)
+                        and func.attr in _METRICS_CTOR_METHODS
+                        and node.args):
+                    return
+                kind, frags = _metric_name_parts(node.args[0])
+                if kind == "literal":
+                    rows.append((frags[0], func.attr, self.qualname,
+                                 node.lineno))
+
+        V().visit(src.tree)
+        return rows
+
+    def absorb(self, relpath: str, summary) -> None:
+        for name, mtype, qualname, line in summary or ():
+            self._families.setdefault(name, {}).setdefault(
+                mtype, (relpath, qualname, line))
+
+    def finalize(self) -> Iterator[Finding]:
+        for name in sorted(self._families):
+            by_type = self._families[name]
+            if len(by_type) < 2:
+                continue
+            sites = sorted((mtype, loc) for mtype, loc in by_type.items())
+            (first_type, first_loc) = sites[0]
+            others = ", ".join(
+                f"{mtype} at {loc[0]}:{loc[2]}" for mtype, loc in sites[1:])
+            yield Finding(
+                self.id, first_loc[0], first_loc[2], first_loc[1],
+                f"metric family {name!r} registered as {first_type} here "
+                f"but also as {others} — the registry raises on the "
+                f"second type at runtime; pick one type per family")
+        self._families.clear()
+
+
 ALL_RULES: Tuple[type, ...] = (
     SilentExceptRule, LockDisciplineRule, HotPathPurityRule,
     ConsensusFloatRule, UnorderedIterationRule, FailpointHygieneRule,
     ServingBoundednessRule, BackendIsolationRule, FoldOrderRule,
     ReadTierLockRule, ShardWorkerIsolationRule, ShardingDisciplineRule,
-    LockOrderRule,
+    LockOrderRule, MetricsFamilyRule,
 )
 
 

@@ -82,6 +82,12 @@ def _direction(metric: str, unit: Optional[str]) -> Optional[str]:
         # an analytic model, not a measurement: the sentinel reports it
         # but never gates on it (the provenance-split contract)
         return None
+    if "overhead" in m:
+        # config-21 profiler-overhead A/B: the <=2% gate lives in the
+        # bench itself where the legs run back-to-back; cross-round
+        # wall-clock noise on the shared box swamps a sub-2% effect,
+        # so the sentinel reports the series without gating
+        return None
     if "per_sec" in m or "/s" in u:
         return "higher"
     if m.endswith(("_s", "_ms", "_seconds")) or u in ("s", "ms", "seconds"):

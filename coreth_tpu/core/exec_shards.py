@@ -76,6 +76,55 @@ _c_demotions = _metrics.counter("exec/shard/demotions")
 _c_fork_guard = _metrics.counter("exec/shard/fork_guard_trips")
 _g_workers = _metrics.gauge("exec/shard/workers")
 
+# `shard-telemetry-enabled` knob: gates the registry-merge of the
+# worker-shipped ShardStats deltas (the piggyback itself always rides
+# the reply — one small dict per dispatch — and the flight-record
+# per-worker stamp stays on, so crash triage never loses it)
+_telemetry_enabled = True
+
+
+def set_telemetry_enabled(on: bool) -> None:
+    global _telemetry_enabled
+    _telemetry_enabled = bool(on)
+
+
+def telemetry_enabled() -> bool:
+    return _telemetry_enabled
+
+
+def _merge_worker_stats(raw: Dict[int, dict]) -> None:
+    """Fold one dispatch's worker ShardStats snapshots into the parent
+    registry under exec/shard/worker/<i>/*. Called exactly once per
+    fully-successful dispatch (a failed dispatch merges nothing, so a
+    crash/respawn can never double-count)."""
+    if not _telemetry_enabled:
+        return
+    for i, snap in raw.items():
+        prefix = f"exec/shard/worker/{i}/"
+        for k, n in snap.get("counts", {}).items():
+            _metrics.counter(prefix + k).inc(n)
+        for k, s in snap.get("seconds", {}).items():
+            _metrics.timer(prefix + k + "_seconds").update(s)
+
+
+def per_worker_view(raw: Dict[int, dict]) -> Dict[str, dict]:
+    """Compact flight-record stamp: the config-19 decomposition of each
+    shard's dispatch into worker-CPU (execute - pipe_wait) vs
+    pipe-serialization time."""
+    view: Dict[str, dict] = {}
+    for i in sorted(raw):
+        snap = raw[i]
+        counts = snap.get("counts", {})
+        secs = snap.get("seconds", {})
+        view[str(i)] = {
+            "txs": counts.get("txs", 0),
+            "spec_failures": counts.get("spec_failures", 0),
+            "pipe_reads": counts.get("pipe_reads", 0),
+            "execute_seconds": round(secs.get("execute", 0.0), 6),
+            "pipe_wait_seconds": round(secs.get("pipe_wait", 0.0), 6),
+        }
+    return view
+
 
 def effective_shards(cfg_val: Optional[int] = None) -> int:
     """CORETH_TPU_EVM_EXEC_SHARDS > evm-exec-shards config > 0 (off)."""
@@ -139,6 +188,9 @@ class ShardPool:
         self.healthy = True
         self.consecutive_failures = 0
         self._closed = False
+        # raw ShardStats snapshots from the last fully-successful
+        # dispatch, {worker index: {"counts": ..., "seconds": ...}}
+        self.last_worker_stats: Dict[int, dict] = {}
         for i in range(workers):
             self.workers.append(self._spawn(i))
         self.ping()
@@ -261,7 +313,7 @@ def _serve_read(env, msg):
 
 
 def _drive(worker: _Worker, req: dict, env, timeout: float,
-           out: dict, errs: list) -> None:
+           out: dict, errs: list, stats_out: Optional[dict] = None) -> None:
     """One parent thread per busy worker: ship the exec request, serve
     base-state reads, collect the results. Any protocol break marks the
     worker failed and lands in [errs] — the dispatch then fails whole."""
@@ -278,6 +330,10 @@ def _drive(worker: _Worker, req: dict, env, timeout: float,
                 conn.send(("val", _serve_read(env, msg)))
             elif kind == "done":
                 out[worker.index] = msg[1]
+                # ShardStats piggyback (len-2 "done" = pre-telemetry
+                # worker, tolerated during a rolling respawn)
+                if stats_out is not None and len(msg) > 2:
+                    stats_out[worker.index] = msg[2]
                 return
             elif kind == "done_error":
                 raise ShardFailure(
@@ -336,6 +392,7 @@ def run_shard_incarnations(pool: ShardPool, env) -> bool:
 
     bc = env.block_ctx
     out: Dict[int, list] = {}
+    stats_out: Dict[int, dict] = {}
     errs: List[BaseException] = []
     threads = []
     with span("exec/shard/dispatch", txs=n, workers=nw):
@@ -355,7 +412,7 @@ def run_shard_incarnations(pool: ShardPool, env) -> bool:
             }
             t = threading.Thread(
                 target=_drive, args=(workers[w], req, env, timeout, out,
-                                     errs),
+                                     errs, stats_out),
                 name=f"shard-drive-{w}", daemon=True)
             t.start()
             threads.append(t)
@@ -369,6 +426,11 @@ def run_shard_incarnations(pool: ShardPool, env) -> bool:
         raise ShardFailure(
             f"{len(errs)} shard(s) failed ({errs[0]}); serial fallback")
     pool.note_dispatch(True)
+    # exactly-once merge point: only a dispatch where every driver
+    # returned clean reaches here, and each reply's stats dict is that
+    # dispatch's drained deltas (snapshot_and_reset on the child)
+    pool.last_worker_stats = stats_out
+    _merge_worker_stats(stats_out)
 
     results = sorted(r for rs in out.values() for r in rs)
     for i, err_repr, ws_parts, reads, gas_ops, res_parts in results:
@@ -458,6 +520,7 @@ def execute_block_sharded(chain_config, block, parent, statedb, block_ctx,
 
     stats["conflicts"] = env.conflicts
     stats["reexecs"] = env.reexecs
+    stats["per_worker"] = per_worker_view(pool.last_worker_stats)
     if not ok:
         _c_fallbacks.inc()
         return None, stats

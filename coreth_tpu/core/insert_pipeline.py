@@ -172,7 +172,7 @@ class _Entry:
 
     __slots__ = ("block", "hash", "header", "parent_header", "rec", "ctx",
                  "phases", "results", "coinbase", "base", "overlay",
-                 "spec_iv", "spec_shards")
+                 "spec_iv", "spec_shards", "spec_worker_stats")
 
     def __init__(self, block: Block, parent_header: Header, rec: dict,
                  ctx) -> None:
@@ -199,6 +199,9 @@ class _Entry:
         # worker count when forked exec shards ran this block's
         # speculation; 0 = in-process serial speculation
         self.spec_shards: int = 0
+        # per-worker ShardStats view for the flight record (exec_shards
+        # per_worker_view shape); {} when shards didn't run
+        self.spec_worker_stats: dict = {}
 
 
 class InsertPipeline:
@@ -434,7 +437,11 @@ class InsertPipeline:
         product is the same dense per-tx `_TxResult` list; shard-path
         failures abort speculation (serial fallback at commit), never
         the insert."""
-        from .exec_shards import MIN_SHARD_TXS, run_shard_incarnations
+        from .exec_shards import (
+            MIN_SHARD_TXS,
+            per_worker_view,
+            run_shard_incarnations,
+        )
 
         pool = self.chain.processor.shard_pool()
         if pool is not None and len(txs) >= MIN_SHARD_TXS:
@@ -445,6 +452,7 @@ class InsertPipeline:
             if not run_shard_incarnations(pool, env):
                 raise _SpecAbort("shard sweep failed")
             entry.spec_shards = len(pool.workers)
+            entry.spec_worker_stats = per_worker_view(pool.last_worker_stats)
             return [env.results[i] for i in range(len(txs))]
         results: List = []
         for i in range(len(txs)):
@@ -643,7 +651,8 @@ class InsertPipeline:
                                       entry.parent_header, statedb, receipts)
             rec = entry.rec
             rec["parallel"] = {"mode": "pipeline-spec",
-                               "shards": entry.spec_shards}
+                               "shards": entry.spec_shards,
+                               "per_worker": entry.spec_worker_stats}
             with _PhaseClock("validate", entry.phases, _metrics):
                 chain.validator.validate_state(block, statedb, receipts,
                                                used_gas)

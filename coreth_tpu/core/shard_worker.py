@@ -2,12 +2,14 @@
 
 This module is the ONLY code that runs at a shard worker's top level, and
 it is held to the SA011 isolation contract: module-level imports are
-stdlib + `coreth_tpu.fault` only (the sanctioned failpoint home), no
-module-level mutable state, and no touching of the parent's concurrency
-surface — chainmu, the metrics registry singletons, thread pools. The
-heavyweight EVM machinery (`parallel_exec`, `evm.evm`) is imported
-lazily inside the exec handler, where it runs on the child's own
-copy-on-write image.
+stdlib, `coreth_tpu.fault` (the sanctioned failpoint home) and
+`coreth_tpu.metrics.shardstats` (the fork-clean, stdlib-only telemetry
+accumulator — explicitly allowlisted by SA011, which still bans the real
+registry), no module-level mutable state, and no touching of the
+parent's concurrency surface — chainmu, the metrics registry singletons,
+thread pools. The heavyweight EVM machinery (`parallel_exec`,
+`evm.evm`) is imported lazily inside the exec handler, where it runs on
+the child's own copy-on-write image.
 
 Protocol (one duplex Pipe per worker, strict request/response, child is
 single-threaded):
@@ -20,7 +22,10 @@ single-threaded):
                       ("read", kind, ...)  base-state miss, served by the
                                            parent from its _BaseReader /
                                            overlay / BLOCKHASH resolver
-                      ("done", results)    per-tx result tuples
+                      ("done", results, stats)
+                                           per-tx result tuples + this
+                                           dispatch's ShardStats deltas
+                                           (two flat str->number dicts)
                       ("done_error", r)    results failed to pickle
 
 Each assigned tx executes incarnation 0 against an EMPTY multi-version
@@ -46,6 +51,7 @@ import os
 import threading
 
 from .. import fault
+from ..metrics.shardstats import ShardStats
 
 # exit code for a failpoint-induced hard death; distinct from a SIGKILL's
 # negative exitcode but equally "no cleanup ran"
@@ -57,15 +63,22 @@ class _PipeBase:
     cache and serves misses over the pipe. Memoised: each (kind, key) is
     one round-trip for the life of the exec request."""
 
-    __slots__ = ("conn", "accounts", "slots", "codes")
+    __slots__ = ("conn", "accounts", "slots", "codes", "stats")
 
-    def __init__(self, conn, prefetch):
+    def __init__(self, conn, prefetch, stats=None):
         self.conn = conn
         self.accounts = dict(prefetch.get("accounts", {}))
         self.slots = dict(prefetch.get("slots", {}))
         self.codes = dict(prefetch.get("codes", {}))
+        self.stats = stats
 
     def _rpc(self, kind, *args):
+        if self.stats is not None:
+            self.stats.inc("pipe_reads")
+            with self.stats.timed("pipe_wait"):
+                self.conn.send(("read", kind) + args)
+                _tag, val = self.conn.recv()
+            return val
         self.conn.send(("read", kind) + args)
         _tag, val = self.conn.recv()
         return val
@@ -94,7 +107,7 @@ class _PipeBase:
         return c
 
 
-def _handle_exec(conn, chain_config, req) -> None:
+def _handle_exec(conn, chain_config, req, stats: ShardStats) -> None:
     # the per-request crash site: raise -> hard exit (the parent sees a
     # dead pipe, exactly like a real crash); hang -> parked, SIGKILL-able
     try:
@@ -124,7 +137,7 @@ def _handle_exec(conn, chain_config, req) -> None:
         base_fee=req["base_fee"],
         get_hash=get_hash,
     )
-    base = _PipeBase(conn, req["prefetch"])
+    base = _PipeBase(conn, req["prefetch"], stats)
     # deliberately EMPTY and never published to: every read resolves to
     # BASE, and the parent's sweep validates those versions for real
     table = _VersionedTable()
@@ -133,31 +146,37 @@ def _handle_exec(conn, chain_config, req) -> None:
     msgs = req["msgs"]
 
     out = []
-    for i in req["indices"]:
-        msg = msgs[i]
-        view = VersionedStateView(table, base, i, coinbase)
-        gp = _RecordingGasPool()
-        evm.reset(TxContext(origin=msg.from_, gas_price=msg.gas_price), view)
-        try:
-            result = apply_message(evm, msg, gp)
-            ws = view.build_write_set()
-            out.append((
-                i, None,
-                (ws.accounts, ws.storage, ws.barriers, ws.logs,
-                 ws.preimages, ws.fee),
-                view.reads, gp.ops,
-                (result.used_gas,
-                 repr(result.err) if result.err is not None else None,
-                 result.return_data),
-            ))
-        except Exception as exc:
-            # speculative failure (coinbase read, validation error, …):
-            # ship the marker; the parent leaves the slot empty and its
-            # sweep re-executes tx i against final state
-            err_repr = repr(exc)
-            out.append((i, err_repr, None, None, None, None))
+    with stats.timed("execute"):
+        for i in req["indices"]:
+            msg = msgs[i]
+            view = VersionedStateView(table, base, i, coinbase)
+            gp = _RecordingGasPool()
+            evm.reset(
+                TxContext(origin=msg.from_, gas_price=msg.gas_price), view)
+            try:
+                result = apply_message(evm, msg, gp)
+                ws = view.build_write_set()
+                out.append((
+                    i, None,
+                    (ws.accounts, ws.storage, ws.barriers, ws.logs,
+                     ws.preimages, ws.fee),
+                    view.reads, gp.ops,
+                    (result.used_gas,
+                     repr(result.err) if result.err is not None else None,
+                     result.return_data),
+                ))
+                stats.inc("txs")
+            except Exception as exc:
+                # speculative failure (coinbase read, validation error, …):
+                # ship the marker; the parent leaves the slot empty and its
+                # sweep re-executes tx i against final state
+                err_repr = repr(exc)
+                out.append((i, err_repr, None, None, None, None))
+                stats.inc("spec_failures")
     try:
-        conn.send(("done", out))
+        # "execute" above includes time parked in _PipeBase pipe waits;
+        # the parent derives worker-CPU as execute - pipe_wait
+        conn.send(("done", out, stats.snapshot_and_reset()))
     except Exception as exc:
         # unpicklable write-set member — reduce to an error the parent
         # turns into a serial fallback
@@ -174,6 +193,9 @@ def worker_main(conn, index: int, chain_config) -> None:
     # respawned, not inherited — the parent counts these as
     # exec/shard/fork_guard_trips)
     stale_threads = threading.active_count() - 1
+    # function-local by SA011 decree (no module-level mutable state);
+    # deltas drain into each ("done", out, stats) reply
+    stats = ShardStats()
     while True:
         try:
             msg = conn.recv()
@@ -187,6 +209,6 @@ def worker_main(conn, index: int, chain_config) -> None:
         elif kind == "crash":
             os._exit(CRASH_EXIT)
         elif kind == "exec":
-            _handle_exec(conn, chain_config, msg[1])
+            _handle_exec(conn, chain_config, msg[1], stats)
         else:
             conn.send(("error", f"unknown message kind {kind!r}"))

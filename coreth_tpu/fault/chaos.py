@@ -323,6 +323,13 @@ class Conductor:
         self.witness.wrap(self.txpool, "mu", "TxPool.mu")
         self.witness.wrap(default_registry, "_lock", "Registry._lock")
 
+        # sampling profiler armed hot for the whole run (invariant #7):
+        # 50 Hz against every witnessed lock above — the sampler must
+        # never throw into the workload, and its lock-tag reads of the
+        # witness mirror must not perturb the lock-order record
+        from ..metrics.profiler import start_profiler
+        self.profiler = start_profiler(50.0, ring_size=4096)
+
         self.watchdog = _Watchdog(self.step_budget)
         self.expected = _expected_types()
 
@@ -332,6 +339,12 @@ class Conductor:
             self.chain.stop()
         except Exception as e:  # noqa: BLE001 - teardown is best-effort
             self._record_violation("shutdown", f"chain.stop failed: {e!r}")
+        if getattr(self, "profiler", None) is not None:
+            # stop sampling BEFORE the witness unwraps: the sampler's
+            # lock-tag reads reference the witness held-stack mirror
+            from ..metrics.profiler import stop_profiler
+            stop_profiler()
+            self.profiler = None
         if getattr(self, "witness", None) is not None:
             # the metrics registry is process-global; it must not keep a
             # witness proxy once this conductor is gone
@@ -769,6 +782,18 @@ class Conductor:
             for v in self.witness.violations:
                 self._record_violation("lock-order", v, step)
             self.witness.violations = []
+        # 7. the sampling profiler stayed silent and alive: its tick is
+        # fenced — any exception it swallowed counts sampler_errors, and
+        # a dead sampler thread means a tick escaped the fence entirely
+        if self.profiler is not None:
+            errs = self._counter_delta("profile/sampler_errors")
+            if errs > 0:
+                self._record_violation(
+                    "profiler-error",
+                    f"{errs} fenced sampler exception(s)", step)
+            if not self.profiler.alive():
+                self._record_violation(
+                    "profiler-dead", "sampler thread exited mid-run", step)
 
     # ---- kill drill ------------------------------------------------------
 
@@ -978,6 +1003,8 @@ class Conductor:
                         self._counter_delta("exec/shard/respawns"),
                     "shard_fallbacks":
                         self._counter_delta("exec/shard/fallbacks"),
+                    "profiler_errors":
+                        self._counter_delta("profile/sampler_errors"),
                 },
             }
             return result

@@ -220,8 +220,13 @@ class Timer:
 
 # --- Prometheus exposition helpers ------------------------------------------
 
-# legal sample-name charset: [a-zA-Z_:][a-zA-Z0-9_:]*
-_NAME_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_:]")
+# Exposition sample names may legally contain ':' ([a-zA-Z_:][a-zA-Z0-9_:]*)
+# but Prometheus reserves colons for recording rules, and registry names
+# DO contain colons (the module-lock canonical form `module:NAME` feeds
+# the lock/<name>/... contention families) — so the sanitizer rewrites
+# them to '_' like every other separator, keeping scraped families
+# recording-rule-clean and label-legal.
+_NAME_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_]")
 
 # summary quantiles exported for every Timer/Histogram
 _QUANTILES = (0.5, 0.9, 0.99)
@@ -229,10 +234,11 @@ _QUANTILE_LABELS = ("0.5", "0.9", "0.99")
 
 
 def sanitize_metric_name(name: str) -> str:
-    """Registry names use `/` and `.` separators (go-metrics style); the
-    exposition needs `[a-zA-Z_:][a-zA-Z0-9_:]*`."""
+    """Registry names use `/`, `.` and `:` separators (go-metrics style,
+    plus the module-lock canonical form); the exposition gets
+    `[a-zA-Z_][a-zA-Z0-9_]*`."""
     out = _NAME_SANITIZE_RE.sub("_", name)
-    if not out or not (out[0].isalpha() or out[0] in "_:"):
+    if not out or not (out[0].isalpha() or out[0] == "_"):
         out = "_" + out
     return out
 

@@ -244,6 +244,21 @@ def _synthetic_registry() -> Registry:
         slo.update(0.004 * (i % 30), exemplar="rpc-test-%06x" % i)
     slo.update(99.0, exemplar="rpc-test-above-top-bucket")
     r.histogram("slo/chain/insert", buckets=DEFAULT_SLO_BUCKETS)  # empty
+
+    # PR 20 families: lock-contention histograms (including the
+    # module-lock canonical form `module:NAME`, whose ':' the sanitizer
+    # must flatten to a legal exposition name) and profiler counters
+    for lock in ("BlockChain.chainmu", "BlockChain._view_mu",
+                 "blockchain:_ACCEPTOR_SIG"):
+        for kind in ("wait", "hold"):
+            lh = r.histogram(f"lock/{lock}/{kind}_seconds",
+                             buckets=DEFAULT_SLO_BUCKETS)
+            for i in range(50):
+                lh.update(0.002 * (i % 20))
+    r.counter("lock/slow_holds").inc(2)
+    for role in ("rpc", "commit", "tail", "main"):
+        r.counter(f"profile/samples/{role}").inc(100)
+    r.counter("profile/sampler_errors")
     return r
 
 

@@ -41,7 +41,16 @@ from __future__ import annotations
 
 import functools
 import threading
-from typing import List, Optional, Sequence, Tuple
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..metrics import (
+    DEFAULT_SLO_BUCKETS,
+    count_drop,
+    default_registry,
+    sanitize_metric_name,
+)
 
 # The canonical single-process lock order, outermost first.  This is the
 # checked-in linearisation of the may-acquire graph the static analyzer
@@ -76,6 +85,127 @@ CANONICAL_LOCK_ORDER: Tuple[str, ...] = (
 )
 
 
+# --------------------------------------------------------------------------
+# lock-contention telemetry (PR 20): every wrapped canonical lock records
+# acquire-wait and hold time into lock/<name>/{wait,hold}_seconds SLO
+# histograms, and holds longer than the slow-hold budget capture a
+# traceback + trace id into the configured sink (the chain's flight
+# recorder, wired by vm.py / the chaos conductor).  Instruments are
+# pre-bound per canonical name at wrap time — never constructed on the
+# acquire path (SA003's hot-path purity contract).
+
+# seconds a single hold may last before it is captured; 0 disables
+_slow_hold_budget: float = 0.0
+# callable(dict) fed one event per budget breach (flight.note_event shape)
+_slow_hold_sink = None
+# bounded ring of recent breaches for debug_lockStatus (sink-less runs)
+_recent_slow_holds: deque = deque(maxlen=32)
+
+
+def set_slow_hold_budget(seconds: float) -> None:
+    global _slow_hold_budget
+    _slow_hold_budget = max(0.0, float(seconds))
+
+
+def slow_hold_budget() -> float:
+    return _slow_hold_budget
+
+
+def set_slow_hold_sink(sink) -> None:
+    """Install the slow-hold event consumer (None disconnects). The sink
+    must be cheap and non-raising; a raising sink only counts a drop."""
+    global _slow_hold_sink
+    _slow_hold_sink = sink
+
+
+class _LockTelemetry:
+    """Per-canonical-lock wait/hold histograms, created once per name."""
+
+    __slots__ = ("name", "wait", "hold")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.wait = default_registry.histogram(
+            f"lock/{name}/wait_seconds", buckets=DEFAULT_SLO_BUCKETS)
+        self.hold = default_registry.histogram(
+            f"lock/{name}/hold_seconds", buckets=DEFAULT_SLO_BUCKETS)
+
+
+_telemetry_mu = threading.Lock()
+_telemetry: Dict[str, _LockTelemetry] = {}
+# sanitized exposition family -> canonical lock name: the exposition
+# flattens `/`, `.` and `:` to `_`, and this mapping is what keeps the
+# flattening invertible (the round-trip test asserts injectivity over
+# CANONICAL_LOCK_ORDER plus the module-lock `module:NAME` form)
+_family_to_canonical: Dict[str, str] = {}
+
+
+def lock_telemetry(name: str) -> _LockTelemetry:
+    with _telemetry_mu:
+        tele = _telemetry.get(name)
+        if tele is None:
+            tele = _LockTelemetry(name)
+            _telemetry[name] = tele
+            for kind in ("wait", "hold"):
+                fam = sanitize_metric_name(f"lock/{name}/{kind}_seconds")
+                _family_to_canonical[fam] = name
+        return tele
+
+
+def canonical_for_family(family: str) -> Optional[str]:
+    """Invert the exposition flattening: sanitized `lock_*_{wait,hold}_
+    seconds` family name -> canonical lock name."""
+    with _telemetry_mu:
+        return _family_to_canonical.get(family)
+
+
+def contention_table() -> List[Dict[str, object]]:
+    """The debug_lockStatus payload: one row per instrumented lock,
+    ranked by total measured acquire-wait (descending)."""
+    with _telemetry_mu:
+        items = list(_telemetry.items())
+    rows = []
+    for name, tele in items:
+        rows.append({
+            "lock": name,
+            "wait_count": tele.wait.count(),
+            "wait_total_seconds": tele.wait.sum(),
+            "wait_p99_seconds": tele.wait.percentile(0.99),
+            "hold_count": tele.hold.count(),
+            "hold_total_seconds": tele.hold.sum(),
+            "hold_p99_seconds": tele.hold.percentile(0.99),
+        })
+    rows.sort(key=lambda r: r["wait_total_seconds"], reverse=True)
+    return rows
+
+
+def recent_slow_holds() -> List[Dict[str, object]]:
+    return list(_recent_slow_holds)
+
+
+def _note_slow_hold(name: str, held_s: float) -> None:
+    import traceback
+
+    from ..metrics import tracectx
+
+    default_registry.counter("lock/slow_holds").inc()
+    ev = {
+        "lock": name,
+        "held_seconds": held_s,
+        "budget_seconds": _slow_hold_budget,
+        "thread": threading.current_thread().name,
+        "trace_id": tracectx.current_id(),
+        "stack": "".join(traceback.format_stack(limit=12)),
+    }
+    _recent_slow_holds.append(ev)
+    sink = _slow_hold_sink
+    if sink is not None:
+        try:
+            sink(ev)
+        except Exception:  # noqa: BLE001 - telemetry must not raise into holders
+            count_drop("drop/lock/slow_hold_sink")
+
+
 class _OwnedLock:
     """Proxy around a Lock/RLock that records which thread holds it.
 
@@ -85,16 +215,26 @@ class _OwnedLock:
     is counted so RLock owners stay owners until the outermost release.
     """
 
-    def __init__(self, inner):
+    def __init__(self, inner, name: Optional[str] = None):
         self._inner = inner
         self._owner: int | None = None
         self._count = 0
+        self._tele = lock_telemetry(name) if name else None
+        self._hold_t0 = 0.0
 
     def acquire(self, *a, **kw):
-        got = self._inner.acquire(*a, **kw)
+        if self._tele is None:
+            got = self._inner.acquire(*a, **kw)
+        else:
+            t0 = time.monotonic()
+            got = self._inner.acquire(*a, **kw)
+            if got:
+                self._tele.wait.update(time.monotonic() - t0)
         if got:
             self._owner = threading.get_ident()
             self._count += 1
+            if self._count == 1:
+                self._hold_t0 = time.monotonic()
         return got
 
     def release(self):
@@ -102,6 +242,11 @@ class _OwnedLock:
             self._count -= 1
             if self._count == 0:
                 self._owner = None
+                if self._tele is not None:
+                    held = time.monotonic() - self._hold_t0
+                    self._tele.hold.update(held)
+                    if 0.0 < _slow_hold_budget <= held:
+                        _note_slow_hold(self._tele.name, held)
         self._inner.release()
 
     def __enter__(self):
@@ -135,14 +280,33 @@ class _WitnessLock:
         self._inner = inner
         self._name = name
         self._witness = witness
+        self._tele = lock_telemetry(name)
+        # per-thread (depth, hold-start): re-entrant RLock holds time the
+        # OUTERMOST span, matching what a contending thread experiences
+        self._local = threading.local()
 
     def acquire(self, *a, **kw):
+        t0 = time.monotonic()
         got = self._inner.acquire(*a, **kw)
         if got:
+            now = time.monotonic()
+            self._tele.wait.update(now - t0)
+            depth = getattr(self._local, "depth", 0)
+            if depth == 0:
+                self._local.t0 = now
+            self._local.depth = depth + 1
             self._witness._note_acquire(self._name)
         return got
 
     def release(self):
+        depth = getattr(self._local, "depth", 0)
+        if depth == 1:
+            held = time.monotonic() - self._local.t0
+            self._tele.hold.update(held)
+            if 0.0 < _slow_hold_budget <= held:
+                _note_slow_hold(self._name, held)
+        if depth > 0:
+            self._local.depth = depth - 1
         self._inner.release()
         self._witness._note_release(self._name)
 
@@ -192,6 +356,12 @@ class LockOrderWitness:
         self.edges: set = set()
         self._meta = threading.Lock()
         self._held = threading.local()
+        # cross-thread-readable mirror of the per-thread held stacks:
+        # ident -> tuple(names).  `threading.local` is invisible from the
+        # sampling profiler's thread, so every acquire/release also
+        # publishes an immutable snapshot with a single GIL-atomic dict
+        # write — no lock on the acquire path.
+        self._held_by_ident: Dict[int, Tuple[str, ...]] = {}
         self._wrapped: List[tuple] = []
 
     def wrap(self, obj, attr: str, name: Optional[str] = None):
@@ -204,6 +374,8 @@ class LockOrderWitness:
             inner, name or f"{type(obj).__name__}.{attr}", self)
         setattr(obj, attr, proxy)
         self._wrapped.append((obj, attr, inner))
+        if self not in _ACTIVE_WITNESSES:
+            _ACTIVE_WITNESSES.append(self)
         return proxy
 
     def unwrap_all(self) -> None:
@@ -216,6 +388,11 @@ class LockOrderWitness:
             except AttributeError:
                 pass
         self._wrapped.clear()
+        try:
+            _ACTIVE_WITNESSES.remove(self)
+        except ValueError:
+            pass
+        self._held_by_ident.clear()
 
     def _stack(self) -> List[str]:
         st = getattr(self._held, "stack", None)
@@ -227,6 +404,7 @@ class LockOrderWitness:
         stack = self._stack()
         if name in stack:  # RLock re-entry: no new edge, no new rank
             stack.append(name)
+            self._publish(stack)
             return
         rank = self._rank.get(name)
         with self._meta:
@@ -243,6 +421,7 @@ class LockOrderWitness:
                         f"{' -> '.join(dict.fromkeys(stack))} "
                         f"(violates canonical order via {worst[-1]})")
         stack.append(name)
+        self._publish(stack)
 
     def _note_release(self, name: str) -> None:
         stack = self._stack()
@@ -251,7 +430,35 @@ class LockOrderWitness:
         for i in range(len(stack) - 1, -1, -1):
             if stack[i] == name:
                 del stack[i]
+                self._publish(stack)
                 return
+
+    def _publish(self, stack: List[str]) -> None:
+        ident = threading.get_ident()
+        if stack:
+            self._held_by_ident[ident] = tuple(stack)
+        else:
+            self._held_by_ident.pop(ident, None)
+
+    def held_by_ident(self) -> Dict[int, Tuple[str, ...]]:
+        """Point-in-time copy of which thread holds which witnessed
+        locks; safe to call from any thread (the profiler's sampler)."""
+        return dict(self._held_by_ident)
+
+
+# Witnesses with live wraps, so the profiler can tag samples with the
+# locks the sampled thread holds without a reference to the harness that
+# installed them.  Appended on first wrap, removed in unwrap_all().
+_ACTIVE_WITNESSES: List["LockOrderWitness"] = []
+
+
+def held_locks_snapshot() -> Dict[int, Tuple[str, ...]]:
+    """Merged ident -> held-lock-names view across all live witnesses."""
+    out: Dict[int, Tuple[str, ...]] = {}
+    for w in list(_ACTIVE_WITNESSES):
+        for ident, names in w.held_by_ident().items():
+            out[ident] = out.get(ident, ()) + names
+    return out
 
 
 class RaceDetector:
@@ -280,7 +487,7 @@ class RaceDetector:
         feed the ownership record."""
         lock = getattr(obj, lock_attr)
         if not isinstance(lock, _OwnedLock):
-            lock = _OwnedLock(lock)
+            lock = _OwnedLock(lock, name=f"{type(obj).__name__}.{lock_attr}")
             setattr(obj, lock_attr, lock)
         for name in methods:
             orig = getattr(obj, name)

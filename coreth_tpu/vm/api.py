@@ -550,6 +550,35 @@ class DebugMetricsAPI:
 
         return tracer.chrome_trace(clear=bool(clear))
 
+    def profileDump(self, fmt: str = "json") -> object:
+        """debug_profileDump: the sampling profiler's bounded
+        collapsed-stack table. fmt="collapsed" returns flamegraph-ready
+        text (`role;frame;...;frame count` lines, pipe straight into
+        flamegraph.pl); anything else returns the full JSON dump
+        (per-role sample counts, lock-tagged stacks, overflow count).
+        Empty/running=False when profiler-hz is 0."""
+        from ..metrics.profiler import profile_dump
+
+        dump = profile_dump()
+        if fmt == "collapsed":
+            return dump.get("collapsed", "")
+        return dump
+
+    def lockStatus(self) -> dict:
+        """debug_lockStatus: per-canonical-lock contention table (wait/
+        hold counts, totals, p99s) ranked by total measured acquire-wait,
+        plus the slow-hold budget and the recent budget-breach captures
+        (traceback + trace id). Rows appear once a LockOrderWitness (or
+        require_lock proxy) instruments the lock — the chaos conductor
+        and the race-discipline tests arm one at boot."""
+        from ..utils import racecheck
+
+        return {
+            "slow_hold_budget_seconds": racecheck.slow_hold_budget(),
+            "contention": racecheck.contention_table(),
+            "recent_slow_holds": racecheck.recent_slow_holds(),
+        }
+
     def setSpans(self, enabled: bool) -> bool:
         """debug_setSpans: toggle span collection process-wide at
         runtime; returns the new state."""

@@ -235,6 +235,19 @@ class Config:
     # block-insert SLO budget (seconds): inserts slower than this are
     # auto-captured into the trace ring; 0 disables auto-capture
     chain_insert_slo_budget: float = 0.0
+    # in-process sampling profiler (metrics/profiler.py): samples per
+    # second the daemon thread walks sys._current_frames(); 0 = off.
+    # Process-global like spans — debug_profileDump serves the table.
+    profiler_hz: float = 0.0
+    # max distinct (role, collapsed-stack) rows before new stacks fold
+    # into a per-role overflow bucket
+    profiler_ring_size: int = 2048
+    # seconds a single canonical-lock hold may last before racecheck
+    # captures traceback + trace id into the flight recorder; 0 = off
+    lock_slow_hold_budget: float = 0.0
+    # gates the parent-side registry merge of shard-worker ShardStats
+    # deltas (the per-worker flight-record stamp stays on regardless)
+    shard_telemetry_enabled: bool = True
 
     # --- keystore ---------------------------------------------------------
     keystore_directory: str = ""
@@ -384,6 +397,17 @@ class Config:
             raise ValueError(
                 f"chain-insert-slo-budget must be >= 0 "
                 f"(got {self.chain_insert_slo_budget})")
+        if self.profiler_hz < 0 or self.profiler_hz > 1000:
+            raise ValueError(
+                f"profiler-hz must be in [0, 1000] (got {self.profiler_hz})")
+        if self.profiler_ring_size <= 0:
+            raise ValueError(
+                f"profiler-ring-size must be > 0 "
+                f"(got {self.profiler_ring_size})")
+        if self.lock_slow_hold_budget < 0:
+            raise ValueError(
+                f"lock-slow-hold-budget must be >= 0 "
+                f"(got {self.lock_slow_hold_budget})")
         if self.flight_recorder_size <= 0:
             raise ValueError(
                 f"flight-recorder-size must be > 0 "

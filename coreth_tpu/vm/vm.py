@@ -169,6 +169,16 @@ class VM:
             from ..metrics import tracectx as _tracectx
 
             _tracectx.ring.set_capacity(self.full_config.trace_ring_size)
+        if "lock_slow_hold_budget" in explicit:
+            from ..utils import racecheck as _racecheck
+
+            _racecheck.set_slow_hold_budget(
+                self.full_config.lock_slow_hold_budget)
+        if "shard_telemetry_enabled" in explicit:
+            from ..core import exec_shards as _exec_shards
+
+            _exec_shards.set_telemetry_enabled(
+                self.full_config.shard_telemetry_enabled)
 
         # node keystore (node/ keystore dir role; backs avax.importKey/
         # exportKey/import/export and the eth/personal signing RPC)
@@ -341,6 +351,16 @@ class VM:
                 freq=self.full_config.continuous_profiler_frequency,
                 max_files=self.full_config.continuous_profiler_max_files,
             ).start()
+
+        # in-process sampling profiler (metrics/profiler.py): daemon
+        # thread, process-global singleton — a second VM reuses it
+        self.sampling_profiler = None
+        if self.full_config.profiler_hz > 0:
+            from ..metrics import profiler as _profiler
+
+            self.sampling_profiler = _profiler.start_profiler(
+                self.full_config.profiler_hz,
+                ring_size=self.full_config.profiler_ring_size)
 
         # stdlib /metrics + /healthz endpoint (metrics/http.py), reusing
         # the health_check verdict the RPC health namespace serves
@@ -518,6 +538,11 @@ class VM:
             self.gas_price_updater.stop()
             if self.continuous_profiler is not None:
                 self.continuous_profiler.stop()
+            if self.sampling_profiler is not None:
+                from ..metrics import profiler as _profiler
+
+                _profiler.stop_profiler()
+                self.sampling_profiler = None
             if self.metrics_http is not None:
                 self.metrics_http.stop()
             # graceful RPC drain first: in-flight reads finish (bounded

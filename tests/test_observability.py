@@ -78,7 +78,12 @@ class TestPrometheusExposition:
         assert sanitize_metric_name("9starts") == "_9starts"
         assert sanitize_metric_name("resident/fill+ratio") == \
             "resident_fill_ratio"
-        assert sanitize_metric_name("ok:name_1") == "ok:name_1"
+        # ":" is legal Prometheus but reserved for recording rules; the
+        # profiler's "<lock:Owner.attr>" tags must flatten like any other
+        # hostile character, so the sanitizer folds it too (PR 20)
+        assert sanitize_metric_name("ok:name_1") == "ok_name_1"
+        assert sanitize_metric_name("lock/BlockChain.chainmu/wait_seconds") \
+            == "lock_BlockChain_chainmu_wait_seconds"
 
     def test_validator_rejects_malformed(self):
         bad = "# TYPE x counter\nx{quantile=0.5 nope\n"

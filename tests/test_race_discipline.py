@@ -354,3 +354,142 @@ def test_lock_order_witness_threads_are_independent():
     release.set()
     t.join(5)
     assert w.violations == [], w.violations
+
+
+# ------------------------------------ lock-contention telemetry (PR 20)
+
+def test_lock_contention_histograms_under_staged_drill():
+    """Two threads through a witness-wrapped chainmu: the blocked
+    acquire lands in the wait histogram, the deliberate long hold in
+    the hold histogram, and the contention table (the debug_lockStatus
+    payload) ranks locks by total measured wait."""
+    import time
+
+    from coreth_tpu.utils import racecheck
+
+    class Chain:
+        pass
+
+    chain = Chain()
+    chain.chainmu = threading.RLock()
+    w = racecheck.LockOrderWitness()
+    w.wrap(chain, "chainmu", "BlockChain.chainmu")
+
+    tele = racecheck.lock_telemetry("BlockChain.chainmu")
+    w_n0, w_s0 = tele.wait.count(), tele.wait.sum()
+    h_n0, h_s0 = tele.hold.count(), tele.hold.sum()
+
+    entered = threading.Event()
+
+    def holder():
+        with chain.chainmu:
+            entered.set()
+            time.sleep(0.08)
+
+    t = threading.Thread(target=holder)
+    try:
+        t.start()
+        assert entered.wait(5)
+        with chain.chainmu:  # staged contention: blocks behind holder()
+            pass
+        t.join(5)
+    finally:
+        w.unwrap_all()
+
+    assert tele.wait.count() >= w_n0 + 2  # holder's free acquire + ours
+    assert tele.wait.sum() - w_s0 >= 0.05  # we measurably waited
+    assert tele.hold.count() >= h_n0 + 2
+    assert tele.hold.sum() - h_s0 >= 0.05  # holder's sleep was held time
+
+    rows = racecheck.contention_table()
+    row = next(r for r in rows if r["lock"] == "BlockChain.chainmu")
+    assert row["wait_total_seconds"] >= 0.05
+    assert row["wait_count"] >= 2 and row["hold_count"] >= 2
+    waits = [r["wait_total_seconds"] for r in rows]
+    assert waits == sorted(waits, reverse=True)  # ranked by total wait
+
+    # exposition flattening stays invertible (debug_lockStatus joins
+    # /metrics families back to canonical names through this)
+    from coreth_tpu.metrics import sanitize_metric_name
+
+    family = sanitize_metric_name("lock/BlockChain.chainmu/wait_seconds")
+    assert racecheck.canonical_for_family(family) == "BlockChain.chainmu"
+
+
+def test_slow_hold_capture_carries_trace_id():
+    """Holding a canonical lock past lock-slow-hold-budget captures a
+    traceback + the holder's live trace id into the slow-hold ring and
+    the installed sink."""
+    import time
+
+    from coreth_tpu.metrics import tracectx
+    from coreth_tpu.utils import racecheck
+
+    class Chain:
+        pass
+
+    chain = Chain()
+    chain._view_mu = threading.Lock()
+    w = racecheck.LockOrderWitness()
+    w.wrap(chain, "_view_mu", "BlockChain._view_mu")
+
+    events = []
+    racecheck.set_slow_hold_sink(events.append)
+    racecheck.set_slow_hold_budget(0.01)
+    try:
+        ctx = tracectx.begin("rpc")
+        assert ctx is not None  # tracing defaults on
+        with tracectx.scope(ctx):
+            with chain._view_mu:
+                time.sleep(0.03)
+    finally:
+        racecheck.set_slow_hold_budget(0.0)
+        racecheck.set_slow_hold_sink(None)
+        w.unwrap_all()
+
+    assert events, "slow hold not captured"
+    ev = events[-1]
+    assert ev["lock"] == "BlockChain._view_mu"
+    assert ev["held_seconds"] >= 0.01
+    assert ev["budget_seconds"] == 0.01
+    assert ev["trace_id"] == ctx.trace_id
+    assert "test_race_discipline" in ev["stack"]  # real holder traceback
+    assert any(e["lock"] == "BlockChain._view_mu"
+               for e in racecheck.recent_slow_holds())
+
+
+def test_held_locks_snapshot_is_cross_thread():
+    """The profiler's lock-tagging reads OTHER threads' held stacks;
+    the witness mirror must publish them outside threading.local."""
+    import time
+
+    from coreth_tpu.utils import racecheck
+
+    class Chain:
+        pass
+
+    chain = Chain()
+    chain.chainmu = threading.RLock()
+    w = racecheck.LockOrderWitness()
+    w.wrap(chain, "chainmu", "BlockChain.chainmu")
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with chain.chainmu:
+            entered.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder)
+    try:
+        t.start()
+        assert entered.wait(5)
+        snap = racecheck.held_locks_snapshot()  # read from THIS thread
+        assert snap.get(t.ident) == ("BlockChain.chainmu",)
+        assert threading.get_ident() not in snap
+    finally:
+        release.set()
+        t.join(5)
+        w.unwrap_all()
+    assert racecheck.held_locks_snapshot() == {}

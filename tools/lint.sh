@@ -7,7 +7,7 @@ cd "$(dirname "$0")/.."
 
 rc=0
 
-echo "== coreth_tpu.analysis (AST lint + interprocedural: SA001-SA013) =="
+echo "== coreth_tpu.analysis (AST lint + interprocedural: SA001-SA014) =="
 # --strict-baseline: stale allowlist entries fail too, so a fixed
 # finding can't leave a masking entry behind; the run includes the
 # whole-program passes (call graph, lock-order lint, promotions)
