@@ -520,11 +520,14 @@ def execute_block_sharded(chain_config, block, parent, statedb, block_ctx,
 
     stats["conflicts"] = env.conflicts
     stats["reexecs"] = env.reexecs
-    stats["per_worker"] = per_worker_view(pool.last_worker_stats)
     if not ok:
+        # fallback dispatches merge nothing: no per_worker stamp either,
+        # so a failed block's flight record can't wear another dispatch's
+        # worker stats
         _c_fallbacks.inc()
         return None, stats
 
+    stats["per_worker"] = per_worker_view(pool.last_worker_stats)
     receipts, all_logs, used = fold_results(
         env.txs, env.results, env.coinbase, statedb, block)
     stats["mode"] = "shards"

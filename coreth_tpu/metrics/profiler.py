@@ -222,30 +222,53 @@ class Profiler:
 
 
 # -- module singleton (vm.py wiring + debug_profileDump) -----------------
+#
+# The singleton is REFCOUNTED: every start_profiler() must be paired with
+# one stop_profiler(), and the sampler only dies with the last holder.
+# Without this, one VM's shutdown would silently kill sampling for every
+# other user of the process profiler (a second VM, the chaos conductor,
+# bench_suite's A/B leg).
 
 _profiler: Optional[Profiler] = None
 _singleton_mu = threading.Lock()
+_refs = 0
 
 
 def start_profiler(hz: float, ring_size: int = 2048) -> Optional[Profiler]:
-    """Start (or return the already-running) process profiler; hz <= 0
-    is the documented off switch and returns None."""
-    global _profiler
+    """Start (or take a reference on the already-running) process
+    profiler; hz <= 0 is the documented off switch and returns None.
+    A differing hz never restarts a live sampler — first starter wins
+    and the mismatch is logged instead of silently ignored."""
+    global _profiler, _refs
     if hz <= 0:
         return None
     with _singleton_mu:
         if _profiler is None or not _profiler.alive():
             _profiler = Profiler(hz=hz, ring_size=ring_size)
             _profiler.start()
+            _refs = 1
+        else:
+            _refs += 1
+            if float(hz) != _profiler.hz:
+                from ..log import get_logger, warn
+                warn(get_logger("metrics"),
+                     "sampling profiler already running; keeping its rate",
+                     running_hz=_profiler.hz, requested_hz=float(hz))
         return _profiler
 
 
 def stop_profiler() -> None:
-    global _profiler
+    """Drop one start_profiler() reference; the sampler stops only when
+    the last holder lets go.  A stray stop with no profiler is a no-op."""
+    global _profiler, _refs
     with _singleton_mu:
-        if _profiler is not None:
+        if _profiler is None:
+            return
+        _refs -= 1
+        if _refs <= 0:
             _profiler.stop()
             _profiler = None
+            _refs = 0
 
 
 def get_profiler() -> Optional[Profiler]:

@@ -101,6 +101,13 @@ _slow_hold_sink = None
 # bounded ring of recent breaches for debug_lockStatus (sink-less runs)
 _recent_slow_holds: deque = deque(maxlen=32)
 
+# pre-bound at import, like the per-lock histograms: the breach path must
+# never construct instruments — default_registry.counter() acquires
+# Registry._lock, which the chaos conductor witness-wraps, so a lazy bind
+# during a slow hold OF Registry._lock would re-acquire the still-held
+# non-reentrant inner lock on the same thread and deadlock
+_c_slow_holds = default_registry.counter("lock/slow_holds")
+
 
 def set_slow_hold_budget(seconds: float) -> None:
     global _slow_hold_budget
@@ -184,11 +191,17 @@ def recent_slow_holds() -> List[Dict[str, object]]:
 
 
 def _note_slow_hold(name: str, held_s: float) -> None:
+    """Record one budget breach.  Callers MUST invoke this only AFTER the
+    slow lock has been released: the sink may take arbitrary locks (the
+    flight recorder does), and running it while the slow lock is still
+    held would at best record spurious lock-order edges in the witness
+    and at worst deadlock (a slow hold of Registry._lock meeting any
+    registry access here)."""
     import traceback
 
     from ..metrics import tracectx
 
-    default_registry.counter("lock/slow_holds").inc()
+    _c_slow_holds.inc()
     ev = {
         "lock": name,
         "held_seconds": held_s,
@@ -238,6 +251,7 @@ class _OwnedLock:
         return got
 
     def release(self):
+        slow = 0.0
         if self._count > 0:
             self._count -= 1
             if self._count == 0:
@@ -246,8 +260,10 @@ class _OwnedLock:
                     held = time.monotonic() - self._hold_t0
                     self._tele.hold.update(held)
                     if 0.0 < _slow_hold_budget <= held:
-                        _note_slow_hold(self._tele.name, held)
+                        slow = held
         self._inner.release()
+        if slow > 0.0:  # deferred past release — see _note_slow_hold
+            _note_slow_hold(self._tele.name, slow)
 
     def __enter__(self):
         self.acquire()
@@ -281,9 +297,14 @@ class _WitnessLock:
         self._name = name
         self._witness = witness
         self._tele = lock_telemetry(name)
-        # per-thread (depth, hold-start): re-entrant RLock holds time the
-        # OUTERMOST span, matching what a contending thread experiences
-        self._local = threading.local()
+        # ownership-tracked depth on the PROXY (like _OwnedLock), not
+        # threading.local: a plain Lock acquired on one thread and
+        # released on another (legal, signal-style module locks) must
+        # still close its hold span.  Re-entrant RLock holds time the
+        # OUTERMOST span, matching what a contending thread experiences.
+        self._owner: int | None = None
+        self._count = 0
+        self._hold_t0 = 0.0
 
     def acquire(self, *a, **kw):
         t0 = time.monotonic()
@@ -291,24 +312,27 @@ class _WitnessLock:
         if got:
             now = time.monotonic()
             self._tele.wait.update(now - t0)
-            depth = getattr(self._local, "depth", 0)
-            if depth == 0:
-                self._local.t0 = now
-            self._local.depth = depth + 1
+            self._owner = threading.get_ident()
+            self._count += 1
+            if self._count == 1:
+                self._hold_t0 = now
             self._witness._note_acquire(self._name)
         return got
 
     def release(self):
-        depth = getattr(self._local, "depth", 0)
-        if depth == 1:
-            held = time.monotonic() - self._local.t0
-            self._tele.hold.update(held)
-            if 0.0 < _slow_hold_budget <= held:
-                _note_slow_hold(self._name, held)
-        if depth > 0:
-            self._local.depth = depth - 1
+        slow = 0.0
+        if self._count > 0:
+            self._count -= 1
+            if self._count == 0:
+                self._owner = None
+                held = time.monotonic() - self._hold_t0
+                self._tele.hold.update(held)
+                if 0.0 < _slow_hold_budget <= held:
+                    slow = held
         self._inner.release()
         self._witness._note_release(self._name)
+        if slow > 0.0:  # deferred past release — see _note_slow_hold
+            _note_slow_hold(self._name, slow)
 
     def __enter__(self):
         self.acquire()
@@ -341,12 +365,16 @@ class LockOrderWitness:
         order are recorded (the edge set is still useful triage) but
         never flagged, so partially instrumented runs stay quiet.
 
-    Known blind spot: a `threading.Condition` constructed on a lock
+    Known blind spots: a `threading.Condition` constructed on a lock
     BEFORE the wrap keeps a reference to the raw inner lock, so waits/
     notifies through the condition bypass the proxy.  None of the locks
     in `CANONICAL_LOCK_ORDER` back a Condition today; the chaos
     conductor wraps at boot, right after construction, to keep it that
-    way.
+    way.  And while hold TIMING survives a cross-thread release (the
+    proxy tracks depth by ownership, not thread), the per-thread held
+    STACKS here do not: a lock released by a thread that never acquired
+    it stays on the acquirer's stack, so signal-style locks should not
+    be witness-wrapped where order checking matters.
     """
 
     def __init__(self, order: Sequence[str] = CANONICAL_LOCK_ORDER):

@@ -353,7 +353,9 @@ class VM:
             ).start()
 
         # in-process sampling profiler (metrics/profiler.py): daemon
-        # thread, process-global singleton — a second VM reuses it
+        # thread, refcounted process-global singleton — a second VM (or
+        # the chaos conductor) takes a reference on the same sampler and
+        # our shutdown only drops ours
         self.sampling_profiler = None
         if self.full_config.profiler_hz > 0:
             from ..metrics import profiler as _profiler
@@ -541,6 +543,8 @@ class VM:
             if self.sampling_profiler is not None:
                 from ..metrics import profiler as _profiler
 
+                # drops only THIS VM's reference — other holders of the
+                # process sampler keep sampling
                 _profiler.stop_profiler()
                 self.sampling_profiler = None
             if self.metrics_http is not None:

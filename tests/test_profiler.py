@@ -222,11 +222,18 @@ class TestSamplerThread:
             assert start_profiler(100.0) is p  # already running: reused
             assert get_profiler() is p
             assert profile_dump()["running"] is True
+            # refcounted: the second starter's stop must NOT kill the
+            # sampler for the first (one VM shutting down can't blind
+            # another VM or the chaos conductor)
+            stop_profiler()
+            assert get_profiler() is p and p.alive()
         finally:
             stop_profiler()
         assert get_profiler() is None
         empty = profile_dump()
         assert empty["running"] is False and empty["table"] == []
+        stop_profiler()  # stray stop with no profiler: no-op
+        assert get_profiler() is None
 
 
 # ---------------------------------------------------------------- debug RPC

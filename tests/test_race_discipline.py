@@ -458,6 +458,96 @@ def test_slow_hold_capture_carries_trace_id():
                for e in racecheck.recent_slow_holds())
 
 
+def test_slow_hold_of_registry_lock_does_not_deadlock():
+    """Regression: the breach path must never touch Registry._lock while
+    the slow lock is still held — when the slow lock IS the (witness-
+    wrapped, non-reentrant) registry lock, a lazy counter bind inside
+    _note_slow_hold would re-acquire it on the same thread and hang."""
+    import time
+
+    from coreth_tpu.metrics import default_registry
+    from coreth_tpu.utils import racecheck
+
+    w = racecheck.LockOrderWitness()
+    w.wrap(default_registry, "_lock", "Registry._lock")
+    racecheck.set_slow_hold_budget(0.01)
+    done = threading.Event()
+
+    def breach():
+        with default_registry._lock:
+            time.sleep(0.03)
+        done.set()
+
+    t = threading.Thread(target=breach, daemon=True)
+    try:
+        t.start()
+        assert done.wait(5), "slow hold of Registry._lock deadlocked"
+        # and the registry stays usable afterwards
+        default_registry.counter("test/racecheck/post_breach").inc()
+    finally:
+        racecheck.set_slow_hold_budget(0.0)
+        w.unwrap_all()
+        t.join(5)
+    assert any(e["lock"] == "Registry._lock"
+               for e in racecheck.recent_slow_holds())
+
+
+def test_slow_hold_records_no_spurious_order_violation():
+    """A budget breach on a lock ranked AFTER Registry._lock (Tree.lock)
+    must not make the witness see Registry._lock acquired under it:
+    _note_slow_hold runs only after the slow lock left the held stack."""
+    import time
+
+    from coreth_tpu.metrics import default_registry
+    from coreth_tpu.utils import racecheck
+
+    class Snaps:
+        pass
+
+    snaps = Snaps()
+    snaps.lock = threading.Lock()
+    w = racecheck.LockOrderWitness()
+    # chaos-conductor shape: BOTH locks witnessed, registry included
+    w.wrap(default_registry, "_lock", "Registry._lock")
+    w.wrap(snaps, "lock", "Tree.lock")
+    racecheck.set_slow_hold_budget(0.01)
+    try:
+        with snaps.lock:
+            time.sleep(0.03)
+    finally:
+        racecheck.set_slow_hold_budget(0.0)
+        w.unwrap_all()
+    assert w.violations == [], w.violations
+
+
+def test_witness_hold_timing_survives_cross_thread_release():
+    """threading.Lock may legally be released by a thread that never
+    acquired it (signal-style module locks); the hold span must close
+    and later holds must keep landing in the histogram."""
+    from coreth_tpu.utils import racecheck
+
+    class Mod:
+        pass
+
+    mod = Mod()
+    mod.sig = threading.Lock()
+    w = racecheck.LockOrderWitness()
+    w.wrap(mod, "sig", "module:_TEST_SIG")
+    tele = racecheck.lock_telemetry("module:_TEST_SIG")
+    n0 = tele.hold.count()
+    try:
+        mod.sig.acquire()  # this thread acquires ...
+        t = threading.Thread(target=mod.sig.release)  # ... another releases
+        t.start()
+        t.join(5)
+        assert tele.hold.count() == n0 + 1  # span closed at cross release
+        with mod.sig:  # same-thread reuse afterwards still times the hold
+            pass
+        assert tele.hold.count() == n0 + 2
+    finally:
+        w.unwrap_all()
+
+
 def test_held_locks_snapshot_is_cross_thread():
     """The profiler's lock-tagging reads OTHER threads' held stacks;
     the witness mirror must publish them outside threading.local."""
